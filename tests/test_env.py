@@ -1,0 +1,99 @@
+import pytest
+
+from dst_libp2p_test_node_tpu.config import env as env_mod
+from dst_libp2p_test_node_tpu.config.env import (
+    GossipSubParams,
+    get_peer_details,
+    gossipsub_params_from_env,
+    hostname_ordinal,
+)
+
+
+def test_hostname_ordinal_last_field():
+    # Nim takes the last '-' field (env.nim:16); works for pod-12 and svc-a-3.
+    assert hostname_ordinal("pod-12") == 12
+    assert hostname_ordinal("nimp2p-service-3") == 3
+    assert hostname_ordinal("nohyphen") == 0
+
+
+def test_defaults(monkeypatch):
+    for var in ("PEERS", "CONNECTTO", "MUXER", "FRAGMENTS", "SHADOWENV"):
+        monkeypatch.delenv(var, raising=False)
+    cfg = get_peer_details(hostname="pod-7")
+    assert cfg.my_id == 7
+    assert cfg.network_size == 100
+    assert cfg.connect_to == 10
+    assert cfg.muxer == "yamux"
+    assert cfg.fragments == 1
+    assert not cfg.in_shadow
+    assert cfg.address == "/ip4/0.0.0.0/tcp/5000"
+
+
+def test_shadowenv_accepts_1_and_true(monkeypatch):
+    # topogen writes "1", nodes test "true" — we accept both (SURVEY §7 quirks).
+    for v in ("1", "true", "TRUE", "yes"):
+        monkeypatch.setenv("SHADOWENV", v)
+        assert get_peer_details(hostname="pod-0").in_shadow, v
+    monkeypatch.setenv("SHADOWENV", "false")
+    assert not get_peer_details(hostname="pod-0").in_shadow
+
+
+def test_peer_id_offset(monkeypatch):
+    monkeypatch.setenv("PEER_ID_OFFSET", "1000")
+    assert get_peer_details(hostname="pod-3").my_id == 1003
+
+
+def test_quic_address(monkeypatch):
+    monkeypatch.setenv("MUXER", "quic")
+    assert get_peer_details(hostname="pod-0").address == "/ip4/0.0.0.0/udp/5000/quic-v1"
+
+
+def test_invalid_muxer_rejected(monkeypatch):
+    monkeypatch.setenv("MUXER", "sctp")
+    with pytest.raises(ValueError, match="muxer"):
+        get_peer_details(hostname="pod-0")
+
+
+def test_connectto_must_be_less_than_peers(monkeypatch):
+    # env.nim:31-32
+    monkeypatch.setenv("PEERS", "10")
+    monkeypatch.setenv("CONNECTTO", "10")
+    with pytest.raises(ValueError, match="Not enough peers"):
+        get_peer_details(hostname="pod-0")
+
+
+def test_gossipsub_param_defaults():
+    p = GossipSubParams()
+    assert (p.d, p.d_low, p.d_high, p.d_score, p.d_out, p.d_lazy) == (6, 4, 8, 4, 3, 6)
+    assert p.heartbeat_ms == 1000
+    assert p.prune_backoff_sec == 60
+    assert p.gossip_factor == 0.25
+    assert p.flood_publish
+
+
+def test_gossipsub_env_overrides(monkeypatch):
+    monkeypatch.setenv("GOSSIPSUB_D", "8")
+    monkeypatch.setenv("GOSSIPSUB_D_LOW", "6")
+    monkeypatch.setenv("GOSSIPSUB_D_HIGH", "12")
+    monkeypatch.setenv("GOSSIPSUB_FLOOD_PUBLISH", "false")
+    monkeypatch.setenv("GOSSIPSUB_GOSSIP_FACTOR", "0.5")
+    p = gossipsub_params_from_env()
+    assert p.d == 8 and p.d_low == 6 and p.d_high == 12
+    # derived defaults follow the overridden base values (main.nim:257-259)
+    assert p.d_score == 6 and p.d_out == 4 and p.d_lazy == 8
+    assert not p.flood_publish
+    assert p.gossip_factor == 0.5
+
+
+def test_invalid_int_falls_back_to_default(monkeypatch):
+    # main.nim:79-91: warn + default, no crash.
+    monkeypatch.setenv("GOSSIPSUB_D", "not-a-number")
+    assert gossipsub_params_from_env().d == 6
+
+
+def test_mix_surface(monkeypatch):
+    monkeypatch.setenv("MOUNTSMIX", "true")
+    monkeypatch.setenv("MIXD", "3")
+    monkeypatch.setenv("NUMMIX", "50")
+    cfg = get_peer_details(hostname="pod-0")
+    assert cfg.mounts_mix and cfg.mix_d == 3 and cfg.num_mix == 50

@@ -1,0 +1,103 @@
+import numpy as np
+import pytest
+
+from dst_libp2p_test_node_tpu.config.topology import Topology, TopoParams
+
+
+BASELINE = TopoParams(
+    network_size=100,
+    min_bandwidth=50,
+    max_bandwidth=150,
+    min_latency=40,
+    max_latency=130,
+    anchor_stages=5,
+    msg_size_bytes=15000,
+)
+
+
+def test_stage_bandwidth_ramp():
+    t = Topology.build(BASELINE)
+    # bw_jump = int(100/5) = 20 -> stages 50,70,90,110,130; injector 100.
+    assert t.bw_up_mbit.tolist() == [50, 70, 90, 110, 130, 100]
+
+
+def test_edge_latency_rule():
+    t = Topology.build(BASELINE)
+    # lat_jump = int(90/5) = 18; pair (i,j), j>i: min(ceil((5-j)*18+40), 130)
+    assert t.latency_ms[0, 1] == min((5 - 1) * 18 + 40, 130)  # 112
+    assert t.latency_ms[0, 4] == min((5 - 4) * 18 + 40, 130)  # 58
+    assert t.latency_ms[3, 4] == 58
+    # symmetric
+    assert np.allclose(t.latency_ms, t.latency_ms.T)
+    # self-loop rule: max((5-i)*18, 40)
+    assert t.latency_ms[0, 0] == max(5 * 18, 40)  # 90
+    assert t.latency_ms[4, 4] == max(1 * 18, 40)  # 40
+    # injector fast node: 1 ms everywhere
+    assert np.all(t.latency_ms[5, :] == 1.0)
+
+
+def test_stage_assignment_round_robin():
+    t = Topology.build(BASELINE)
+    assert t.stage_of_peer[0] == 0
+    assert t.stage_of_peer[7] == 2
+    assert t.stage_of_peer[99] == 99 % 5
+
+
+def test_tx_time():
+    t = Topology.build(BASELINE)
+    tx = t.tx_ms_per_peer(15000)
+    # stage0 peer: 15000*8 bits / 50 Mbit/s = 2.4 ms
+    assert tx[0] == pytest.approx(2.4)
+    assert tx[4] == pytest.approx(15000 * 8 / 130e6 * 1e3)
+
+
+def test_gml_roundtrip(tmp_path):
+    t = Topology.build(BASELINE)
+    gml = str(tmp_path / "network_topology.gml")
+    t.write_gml(gml)
+    t2 = Topology.from_gml(gml, network_size=100)
+    assert t2.n_stages == 5
+    assert np.allclose(t.latency_ms, t2.latency_ms)
+    assert np.allclose(t.bw_up_mbit, t2.bw_up_mbit)
+    assert np.array_equal(t.stage_of_peer, t2.stage_of_peer)
+
+
+def test_shadow_yaml_schema(tmp_path):
+    import yaml
+
+    t = Topology.build(BASELINE)
+    path = str(tmp_path / "shadow.yaml")
+    t.write_shadow_yaml(path)
+    with open(path) as f:
+        cfg = yaml.safe_load(f)
+    assert cfg["general"]["stop_time"] == "15m"
+    assert cfg["general"]["bootstrap_end_time"] == "10s"
+    assert cfg["network"]["graph"]["type"] == "gml"
+    hosts = cfg["hosts"]
+    # pods 0..99 plus the pod-100 publish controller
+    assert len(hosts) == 101
+    pod0 = hosts["pod-0"]["processes"][0]
+    assert pod0["environment"]["PEERS"] == "100"
+    assert pod0["environment"]["CONNECTTO"] == "10"
+    assert pod0["environment"]["MUXER"] == "yamux"
+    assert pod0["start_time"] == "5s"
+    ctrl = hosts["pod-100"]["processes"][0]
+    assert ctrl["start_time"] == "500s"
+    assert "traffic_sync.py" in ctrl["args"]
+    # round-robin network node assignment
+    assert hosts["pod-7"]["network_node_id"] == 2
+
+
+def test_validation():
+    with pytest.raises(ValueError):
+        Topology.build(TopoParams(min_bandwidth=100, max_bandwidth=50))
+    with pytest.raises(ValueError):
+        Topology.build(TopoParams(min_latency=100, max_latency=50))
+    with pytest.raises(ValueError):
+        Topology.build(TopoParams(num_frags=0))
+
+
+def test_single_stage_degenerate():
+    t = Topology.build(TopoParams(network_size=10, anchor_stages=1))
+    assert t.latency_ms[0, 0] == 100.0  # max((1-0)*0, 100)
+    assert np.all(t.stage_of_peer == 0)
