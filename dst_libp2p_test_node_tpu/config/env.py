@@ -65,7 +65,13 @@ def env_str(name: str, default: str) -> str:
 
 
 def hostname_ordinal(hostname: str | None = None) -> int:
-    """'pod-12' -> 12, 'nimp2p-service-3' -> 3 (env.nim:16: split('-')[^1])."""
+    """'pod-12' -> 12, 'nimp2p-service-3' -> 3 (env.nim:16: split('-')[^1]).
+
+    An unparseable hostname falls back to ordinal 0. The reference is split on
+    this: the flagship node's bare parseInt raises (env.nim:16) while
+    connmanager deliberately catches and defaults to 0
+    (connmanager/env.nim:93-95); we follow the forgiving rule so the framework
+    also runs outside ordinal-named StatefulSet pods (tests, notebooks)."""
     h = hostname if hostname is not None else socket.gethostname()
     try:
         return int(h.split("-")[-1])
@@ -84,9 +90,9 @@ class GossipSubParams:
     d: int = 6
     d_low: int = 4
     d_high: int = 8
-    d_score: int = 4          # default = dLow (main.nim:257)
-    d_out: int = 3            # default = d div 2 (main.nim:258)
-    d_lazy: int = 6           # default = d (main.nim:259)
+    d_score: int | None = None  # default = dLow (main.nim:257)
+    d_out: int | None = None    # default = d div 2 (main.nim:258)
+    d_lazy: int | None = None   # default = d (main.nim:259)
 
     heartbeat_ms: int = 1000
     prune_backoff_sec: int = 60
@@ -114,6 +120,16 @@ class GossipSubParams:
 
     # go node extension: IDONTWANT threshold (go-test-node/main.go:165)
     idontwant_message_threshold: int = 1000
+
+    def __post_init__(self) -> None:
+        # derived defaults follow their base params however the object is
+        # built (env path and direct construction share one rule)
+        if self.d_score is None:
+            self.d_score = self.d_low
+        if self.d_out is None:
+            self.d_out = self.d // 2
+        if self.d_lazy is None:
+            self.d_lazy = self.d
 
     def validate(self) -> None:
         if not (self.d_low <= self.d <= self.d_high):

@@ -1,0 +1,268 @@
+"""Message dissemination as an earliest-arrival-time fixpoint (the hot path).
+
+The reference measures one thing above all: per-message dissemination latency
+— a publisher embeds a nanosecond timestamp, every receiver logs
+`<msgId> milliseconds: <delay>` (gossipsub-queues/main.nim:126-154), and awk
+aggregates (shadow/summary_latency*.awk). Shadow produces those delays with a
+full per-packet discrete-event simulation; we produce them as the fixpoint of
+
+    t_rx[q] = min over senders p of
+        t_rx[p] + proc + (rank_p(q)+1) * tx_p + LAT[stage_p, stage_q]
+
+where rank_p(q) is q's position in p's randomized send order (uplink
+serialization: a peer forwarding B bytes to k mesh members occupies its own
+uplink k times in sequence — Shadow's dominant queueing effect for 15 KB
+messages, acknowledged by summary_latency_large.awk:20-24), and LAT is the
+stage-pair latency matrix from the topology.
+
+The iteration is a *pull*: each peer gathers its neighbors' sender-side
+candidate times through the reverse-slot map (ops/graph.py) — two gathers and
+a row-min, no scatter, no dynamic shapes. Because arrival times decrease
+monotonically, the fixpoint equals the discrete-event result for this link
+model. The fixpoint runs twice per fragment: once to discover each peer's
+first sender, then again with the back-edge removed from the send order (the
+reference never forwards a message back to the peer that delivered it, so
+that uplink slot is never occupied).
+
+IHAVE/IWANT gossip joins the same fixpoint as extra candidate edges quantized
+to the emitter's next heartbeat tick (IHAVE -> IWANT -> message = 3 link
+traversals + one serialization). Post-fixpoint, a single accounting pass
+yields duplicate deliveries, per-peer tx/rx bytes, IHAVE/IWANT counts,
+IDONTWANT suppression (go-test-node/main.go:165), and
+firstMessageDeliveries score credit.
+
+Fragmentation (FRAGMENTS > 1, main.nim:177-179) vmaps everything over the
+fragment axis; a relay's uplink additionally carries the f earlier fragments
+(f * k_p extra serialization slots) and a message completes at a receiver
+when its LAST fragment lands (main.nim:147-148).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from .state import SimParams, SimState
+
+INF = jnp.float32(3.4e38)
+
+
+@struct.dataclass
+class DisseminationResult:
+    t_rx_ms: jnp.ndarray       # (N,) absolute full-receipt time, INF if never
+    delay_ms: jnp.ndarray      # (N,) t_rx - t0, INF if never
+    received: jnp.ndarray      # (N,) bool (all fragments)
+    sends: jnp.ndarray         # (N,) int32 message copies sent by each peer
+    copies_rx: jnp.ndarray     # (N,) int32 copies received (>=1 => received)
+    ihave_sent: jnp.ndarray    # () int32
+    iwant_sent: jnp.ndarray    # () int32
+
+
+def _ranks_f32(priority: jnp.ndarray) -> jnp.ndarray:
+    return jnp.argsort(jnp.argsort(priority, axis=-1), axis=-1).astype(jnp.float32)
+
+
+def _next_heartbeat(t, phase, hb_ms):
+    """First heartbeat tick of a peer strictly after time t (per-peer phase —
+    nodes start at different wall times, so ticks are unaligned)."""
+    return (jnp.floor((t - phase) / hb_ms) + 1.0) * hb_ms + phase
+
+
+@partial(
+    jax.jit,
+    static_argnames=("params", "payload_bytes", "fragments", "with_gossip"),
+)
+def disseminate(
+    state: SimState,
+    conns: jnp.ndarray,
+    rev: jnp.ndarray,
+    stage: jnp.ndarray,
+    lat_ms: jnp.ndarray,
+    bw_up_mbit_per_stage: jnp.ndarray,
+    publisher,
+    t0_ms,
+    params: SimParams,
+    payload_bytes: int,
+    fragments: int = 1,
+    with_gossip: bool = True,
+):
+    """Propagate one application message (all fragments) through the mesh.
+
+    Returns (DisseminationResult, new_state). new_state carries advanced RNG,
+    firstMessageDeliveries credit, and byte/duplicate counters.
+    """
+    n, c = conns.shape
+    key, k_rank, k_gossip, k_phase = jax.random.split(state.key, 4)
+
+    frag_bytes = max(payload_bytes // fragments, 16)
+    tx_ms = (frag_bytes * 8.0) / (bw_up_mbit_per_stage[stage] * 1e6) * 1e3  # (N,)
+
+    # forwarding targets: mesh members; the publisher flood-publishes to every
+    # connected topic peer (main.nim:279)
+    has = conns >= 0
+    q_idx = jnp.clip(conns, 0)
+    valid = has & state.alive[q_idx] & state.subscribed[q_idx]
+    tgt = state.mesh_mask & valid
+    if params.flood_publish:
+        is_pub = jnp.arange(n) == publisher
+        tgt = jnp.where(is_pub[:, None], valid, tgt)
+
+    # randomized send order per peer (one draw per message, standing in for
+    # the reference's per-peer queue service order)
+    rprio = jnp.where(tgt, jax.random.uniform(k_rank, (n, c)), INF)
+
+    # gossip edge sampling: non-mesh connected topic peers; count =
+    # max(D_lazy, gossip_factor * |candidates|)  (v1.1 heartbeat gossip)
+    g_cand = valid & ~tgt
+    n_gc = g_cand.sum(axis=-1).astype(jnp.float32)
+    g_count = jnp.maximum(float(params.d_lazy), params.gossip_factor * n_gc)
+    gprio = jnp.where(g_cand, jax.random.uniform(k_gossip, (n, c)), INF)
+    g_tgt = g_cand & (_ranks_f32(gprio) < g_count[:, None])
+    hb_phase = jax.random.uniform(k_phase, (n,)) * params.heartbeat_ms
+
+    lat_edge = lat_ms[stage[:, None], stage[q_idx]]  # (N, C) per-slot latency
+    can_send = state.alive & state.subscribed
+
+    def offers(t_rx, rank, k_p, frag_idx, send_mask):
+        """Arrival-time offers made by every peer on every neighbor slot."""
+        base = t_rx + params.proc_delay_ms
+        # uplink serialization: (rank+1) sends of this fragment, plus the
+        # frag_idx earlier fragments each occupying k_p uplink slots
+        queue = (rank + 1.0 + frag_idx * k_p[:, None]) * tx_ms[:, None]
+        cand = base[:, None] + queue + lat_edge
+        live = can_send[:, None] & (t_rx[:, None] < INF)
+        cand = jnp.where(send_mask & live, cand, INF)
+        if with_gossip:
+            hb = _next_heartbeat(base, hb_phase, params.heartbeat_ms)
+            g = hb[:, None] + 3.0 * lat_edge + tx_ms[:, None]
+            cand = jnp.minimum(cand, jnp.where(g_tgt & live, g, INF))
+        return cand
+
+    def pull(cand):
+        """incoming[q, j] = offer made to q by the neighbor in its slot j."""
+        inc = cand[q_idx, jnp.clip(rev, 0)]
+        return jnp.where(has & (rev >= 0), inc, INF)
+
+    def converge(rank, k_p, frag_idx, t_pub, send_mask):
+        t0 = jnp.full((n,), INF).at[publisher].set(t_pub)
+
+        def cond(carry):
+            _, changed, it = carry
+            return changed & (it < params.max_relax_iters)
+
+        def body(carry):
+            t_rx, _, it = carry
+            inc = pull(offers(t_rx, rank, k_p, frag_idx, send_mask))
+            t_new = jnp.minimum(t_rx, inc.min(axis=-1))
+            return t_new, jnp.any(t_new < t_rx), it + 1
+
+        t_rx, _, _ = jax.lax.while_loop(cond, body, (t0, jnp.bool_(True), 0))
+        return t_rx
+
+    def one_fragment(frag_idx, t_pub):
+        rank1 = _ranks_f32(rprio)
+        k1 = tgt.sum(axis=-1).astype(jnp.float32)
+        t1 = converge(rank1, k1, frag_idx, t_pub, tgt)
+        if not params.exclude_first_sender:
+            return t1, rank1, k1, tgt
+        # phase 2: drop each peer's back-edge to its first sender from the
+        # send order and re-run — the slot is simply never occupied
+        inc1 = pull(offers(t1, rank1, k1, frag_idx, tgt))
+        first_slot = jnp.argmin(inc1, axis=-1)
+        got_remote = (inc1.min(axis=-1) <= t1) & (jnp.arange(n) != publisher)
+        back = jnp.zeros((n, c), bool).at[jnp.arange(n), first_slot].set(True)
+        back = back & got_remote[:, None]
+        send_mask = tgt & ~back
+        rank2 = _ranks_f32(jnp.where(send_mask, rprio, INF))
+        k2 = send_mask.sum(axis=-1).astype(jnp.float32)
+        t2 = converge(rank2, k2, frag_idx, t_pub, send_mask)
+        return t2, rank2, k2, send_mask
+
+    # publisher emits fragments back-to-back (main.nim:177-179)
+    frag_ids = jnp.arange(fragments, dtype=jnp.float32)
+    t_pubs = t0_ms + frag_ids * tx_ms[publisher]
+    t_rx_f, rank_f, k_f, smask_f = jax.vmap(one_fragment)(frag_ids, t_pubs)
+
+    received = jnp.all(t_rx_f < INF, axis=0)
+    t_rx = jnp.where(received, t_rx_f.max(axis=0), INF)  # last fragment completes
+    delay = jnp.where(received, t_rx - t0_ms, INF)
+
+    # ---- post-fixpoint accounting (bytes, duplicates, gossip, score) -------
+    def frag_accounting(frag_idx, t_rx_one, rank, k_p, send_mask):
+        cand = offers(t_rx_one, rank, k_p, frag_idx, send_mask)
+        made_offer = cand < INF
+        inc = pull(cand)
+        first_slot = jnp.argmin(inc, axis=-1)
+        # IDONTWANT (v1.2): target announced receipt before our send began
+        if payload_bytes >= params.idontwant_threshold_bytes:
+            send_start = t_rx_one[:, None] + params.proc_delay_ms + (
+                rank + frag_idx * k_p[:, None]
+            ) * tx_ms[:, None]
+            q_t = jnp.where(has, t_rx_one[q_idx], INF)
+            idw_arrived = q_t + lat_edge < send_start
+            made_offer = made_offer & ~(idw_arrived & send_mask)
+        sends = (made_offer & send_mask).sum(axis=-1)
+        if with_gossip:
+            havers = (t_rx_one < INF) & can_send
+            ihave = (g_tgt & havers[:, None]).sum()
+            hb = _next_heartbeat(
+                t_rx_one + params.proc_delay_ms, hb_phase, params.heartbeat_ms
+            )
+            lacked = jnp.where(has, t_rx_one[q_idx], 0.0) > hb[:, None] + lat_edge
+            gossip_sent = g_tgt & havers[:, None] & lacked
+            iwant = gossip_sent.sum()
+            sends = sends + (gossip_sent & made_offer).sum(axis=-1)
+            sent_any = (made_offer & send_mask) | (gossip_sent & made_offer)
+        else:
+            ihave = jnp.int32(0)
+            iwant = jnp.int32(0)
+            sent_any = made_offer & send_mask
+        copies = _reciprocal_view(sent_any, conns, rev).sum(axis=-1)
+        return sends, copies, ihave, iwant, first_slot
+
+    sends_f, copies_f, ihave_f, iwant_f, first_slot_f = jax.vmap(frag_accounting)(
+        frag_ids, t_rx_f, rank_f, k_f, smask_f
+    )
+    sends = sends_f.sum(axis=0).astype(jnp.int32)
+    copies = copies_f.sum(axis=0).astype(jnp.int32)
+
+    # firstMessageDeliveries: credit the edge that delivered fragment 0 first
+    fs = first_slot_f[0]
+    got = received & (jnp.arange(n) != publisher)
+    fmd = state.fmd.at[jnp.where(got, jnp.arange(n), n), jnp.where(got, fs, 0)].add(
+        1.0, mode="drop"
+    )
+    fmd = jnp.minimum(fmd, params.fmd_cap)
+
+    result = DisseminationResult(
+        t_rx_ms=t_rx,
+        delay_ms=delay,
+        received=received,
+        sends=sends,
+        copies_rx=copies,
+        ihave_sent=ihave_f.sum().astype(jnp.int32),
+        iwant_sent=iwant_f.sum().astype(jnp.int32),
+    )
+    dup = jnp.maximum(copies - fragments, 0)
+    new_state = state.replace(
+        key=key,
+        fmd=fmd,
+        bytes_tx=state.bytes_tx + sends.astype(jnp.float32) * frag_bytes,
+        bytes_rx=state.bytes_rx + copies.astype(jnp.float32) * frag_bytes,
+        dup_rx=state.dup_rx + dup.astype(jnp.int32),
+        ihave_tx=state.ihave_tx + result.ihave_sent,
+        iwant_tx=state.iwant_tx + result.iwant_sent,
+    )
+    return result, new_state
+
+
+def _reciprocal_view(edge_mask: jnp.ndarray, conns: jnp.ndarray, rev: jnp.ndarray):
+    """view[q, j] = edge_mask[conns[q,j], rev[q,j]] — what my neighbors did to
+    me, expressed in my slot space (pure gather through the reverse map)."""
+    q = jnp.clip(conns, 0)
+    r = jnp.clip(rev, 0)
+    v = edge_mask[q, r]
+    return jnp.where((conns >= 0) & (rev >= 0), v, False)

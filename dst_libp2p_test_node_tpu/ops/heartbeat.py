@@ -1,0 +1,146 @@
+"""GossipSub heartbeat as a jit-compiled array step (reference L0 behavior).
+
+One call = one heartbeat of the protocol the reference delegates to
+nim-libp2p/go-libp2p-pubsub/rust-libp2p (configured in
+gossipsub-queues/main.nim:252-332): mesh rebalance (graft when |mesh| < D_low
+up to D, prune when |mesh| > D_high down to D keeping the D_score
+highest-scored members and at least D_out outbound members), PRUNE backoff
+bookkeeping, and peer-score decay.
+
+Everything is a masked fixed-shape op over the (N, C) neighbor-slot arrays;
+reciprocity (GRAFT/PRUNE control messages) is a single scatter through the
+precomputed reverse-slot map (ops/graph.py). Dead neighbors (churn) simply
+fall out of the validity mask and are replaced on the next rebalance — the
+elastic-recovery analog of the reference's dial-retry loops (SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from .state import SimParams, SimState
+
+BIG = jnp.float32(1e30)
+
+
+def _ranks(priority: jnp.ndarray) -> jnp.ndarray:
+    """Per-row rank of each slot under ascending priority (double argsort)."""
+    return jnp.argsort(jnp.argsort(priority, axis=-1), axis=-1)
+
+
+def _reciprocal_scatter(
+    target: jnp.ndarray, conns: jnp.ndarray, rev: jnp.ndarray,
+    edge_mask: jnp.ndarray, value,
+) -> jnp.ndarray:
+    """For every (p, i) in edge_mask, write `value` at (conns[p,i], rev[p,i]).
+
+    Non-selected edges are routed out of bounds and dropped — one collision-free
+    scatter replaces the reference's GRAFT/PRUNE RPC round trips."""
+    n = target.shape[0]
+    q = jnp.where(edge_mask, conns, n)  # n is out of bounds -> dropped
+    j = jnp.where(edge_mask, rev, 0)
+    return target.at[q, j].set(value, mode="drop")
+
+
+@partial(jax.jit, static_argnames=("params",))
+def heartbeat_step(
+    state: SimState,
+    conns: jnp.ndarray,
+    rev: jnp.ndarray,
+    out_mask: jnp.ndarray,
+    params: SimParams,
+) -> SimState:
+    n, c = conns.shape
+    key, k_graft, k_keep, k_churn_d, k_churn_u = jax.random.split(state.key, 5)
+    t = state.t_ms
+
+    # -- churn (failure injection; BASELINE config 4) ------------------------
+    alive = state.alive
+    if params.churn_down_per_hb > 0.0 or params.churn_up_per_hb > 0.0:
+        dies = jax.random.uniform(k_churn_d, (n,)) < params.churn_down_per_hb
+        revives = jax.random.uniform(k_churn_u, (n,)) < params.churn_up_per_hb
+        alive = jnp.where(alive, ~dies, revives)
+
+    has_conn = conns >= 0
+    nbr_alive = jnp.where(has_conn, alive[jnp.clip(conns, 0)], False)
+    nbr_sub = jnp.where(has_conn, state.subscribed[jnp.clip(conns, 0)], False)
+    valid = has_conn & alive[:, None] & nbr_alive & nbr_sub & state.subscribed[:, None]
+
+    mesh = state.mesh_mask & valid  # drop edges to dead/unsubscribed peers
+    deg = mesh.sum(axis=-1)
+    scores = state.score(params)
+
+    # -- GRAFT: |mesh| < D_low -> add random eligible peers up to D ----------
+    need = jnp.where(deg < params.d_low, params.d - deg, 0)
+    eligible = valid & ~mesh & (state.backoff_until <= t) & (scores >= 0.0)
+    g_prio = jnp.where(eligible, jax.random.uniform(k_graft, (n, c)), BIG)
+    grafted = (_ranks(g_prio) < need[:, None]) & eligible
+    mesh = mesh | grafted
+    # GRAFT control msg: counterpart adds us to its mesh (handleGraft accepts
+    # unless backed off; overflow is corrected at its own next heartbeat)
+    mesh = _reciprocal_scatter(mesh, conns, rev, grafted, True)
+    mesh = mesh & valid
+
+    # -- PRUNE: |mesh| > D_high -> keep D (D_score best, >= D_out outbound) --
+    deg2 = mesh.sum(axis=-1)
+    over = deg2 > params.d_high
+    rand_keep = jax.random.uniform(k_keep, (n, c))
+    # rank by descending score (random tiebreak) among mesh members
+    s_prio = jnp.where(mesh, -scores + 1e-3 * rand_keep, BIG)
+    top_score = (_ranks(s_prio) < params.d_score) & mesh
+    # at least D_out outbound among the kept set
+    out_in_top = (top_score & out_mask).sum(axis=-1)
+    need_out = jnp.clip(params.d_out - out_in_top, 0, params.d)
+    o_prio = jnp.where(mesh & out_mask & ~top_score, rand_keep, BIG)
+    keep_out = (_ranks(o_prio) < need_out[:, None]) & mesh & out_mask & ~top_score
+    # random fill to exactly D
+    base = top_score | keep_out
+    need_fill = jnp.clip(params.d - base.sum(axis=-1), 0, params.d)
+    f_prio = jnp.where(mesh & ~base, rand_keep, BIG)
+    keep = base | ((_ranks(f_prio) < need_fill[:, None]) & mesh & ~base)
+    pruned = mesh & ~keep & over[:, None]
+    mesh = mesh & ~pruned
+    # PRUNE control msg: counterpart drops us; backoff on both sides
+    backoff = state.backoff_until
+    backoff = jnp.where(pruned, t + params.prune_backoff_ms, backoff)
+    backoff = _reciprocal_scatter(backoff, conns, rev, pruned, t + params.prune_backoff_ms)
+    mesh = _reciprocal_scatter(mesh, conns, rev, pruned, False)
+
+    # -- score decay (decayInterval == heartbeat here; main.nim:272-273) -----
+    fmd = state.fmd * params.fmd_decay
+    fmd = jnp.where(fmd < params.decay_to_zero, 0.0, fmd)
+    slow = state.slow_penalty * 0.2
+    slow = jnp.where(slow < params.decay_to_zero, 0.0, slow)
+
+    return state.replace(
+        mesh_mask=mesh,
+        backoff_until=backoff,
+        fmd=fmd,
+        slow_penalty=slow,
+        alive=alive,
+        t_ms=t + params.heartbeat_ms,
+        key=key,
+        grafts=state.grafts + grafted.sum(dtype=jnp.int32),
+        prunes=state.prunes + pruned.sum(dtype=jnp.int32),
+    )
+
+
+def run_heartbeats(
+    state: SimState,
+    conns: jnp.ndarray,
+    rev: jnp.ndarray,
+    out_mask: jnp.ndarray,
+    params: SimParams,
+    steps: int,
+) -> SimState:
+    """lax.scan over heartbeat rounds — simulated time scales in rounds with
+    no host sync (the reference's 'long simulated time' axis, SURVEY.md §5)."""
+
+    def body(s, _):
+        return heartbeat_step(s, conns, rev, out_mask, params), None
+
+    state, _ = jax.lax.scan(body, state, None, length=steps)
+    return state

@@ -1,0 +1,157 @@
+"""Simulation parameter and state containers.
+
+SimParams is a frozen (hashable) dataclass passed as a *static* jit argument —
+every field participates in trace specialization, mirroring how the reference
+bakes GossipSub params at startup (configureGossipsubParams,
+gossipsub-queues/main.nim:252-332).
+
+SimState is the peer-major device pytree: one row per simulated peer where the
+reference runs one OS process per peer (shadow/topogen.py:102-122).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from ..config.env import GossipSubParams
+
+
+@dataclass(frozen=True)
+class SimParams:
+    """Static simulation parameters (hashable -> jit static arg)."""
+
+    n: int                      # PEERS
+    capacity: int               # neighbor-list capacity C
+    d: int = 6
+    d_low: int = 4
+    d_high: int = 8
+    d_score: int = 4
+    d_out: int = 3
+    d_lazy: int = 6
+    heartbeat_ms: float = 1000.0
+    prune_backoff_ms: float = 60_000.0
+    gossip_factor: float = 0.25
+    flood_publish: bool = True
+    fmd_weight: float = 1.0     # firstMessageDeliveries topic params (main.nim:335-340)
+    fmd_cap: float = 30.0
+    fmd_decay: float = 0.9
+    decay_to_zero: float = 0.01
+    proc_delay_ms: float = 2.0  # per-hop validation/processing latency
+    max_relax_iters: int = 48   # bound on the earliest-arrival fixpoint
+    exclude_first_sender: bool = True   # don't forward back to the delivering peer
+    idontwant_threshold_bytes: int = 1000  # go-test-node/main.go:165 (v1.2)
+    churn_down_per_hb: float = 0.0  # P(alive peer dies) per heartbeat
+    churn_up_per_hb: float = 0.0    # P(dead peer revives) per heartbeat
+
+    def validate(self) -> None:
+        if not (0 < self.d_low <= self.d <= self.d_high <= self.capacity):
+            raise ValueError(
+                "require 0 < d_low <= d <= d_high <= capacity, got "
+                f"{self.d_low} <= {self.d} <= {self.d_high} <= {self.capacity}"
+            )
+        if self.n < 2:
+            raise ValueError("need at least 2 peers")
+        if self.heartbeat_ms <= 0:
+            raise ValueError("heartbeat_ms must be positive")
+
+    @classmethod
+    def from_gossipsub(
+        cls, n: int, capacity: int, g: GossipSubParams, **overrides
+    ) -> "SimParams":
+        return cls(
+            n=n,
+            capacity=capacity,
+            d=g.d,
+            d_low=g.d_low,
+            d_high=g.d_high,
+            d_score=g.d_score,
+            d_out=g.d_out,
+            d_lazy=g.d_lazy,
+            heartbeat_ms=float(g.heartbeat_ms),
+            prune_backoff_ms=float(g.prune_backoff_sec) * 1000.0,
+            gossip_factor=g.gossip_factor,
+            flood_publish=g.flood_publish,
+            fmd_weight=g.first_message_deliveries_weight,
+            fmd_cap=g.first_message_deliveries_cap,
+            fmd_decay=g.first_message_deliveries_decay,
+            decay_to_zero=g.decay_to_zero,
+            idontwant_threshold_bytes=g.idontwant_message_threshold,
+            **overrides,
+        )
+
+
+@struct.dataclass
+class SimState:
+    """Device-side per-peer protocol state (a jax pytree)."""
+
+    mesh_mask: jnp.ndarray      # (N, C) bool — GossipSub mesh ⊆ connections
+    fanout_mask: jnp.ndarray    # (N, C) bool — fanout set for unsubscribed publishers
+    backoff_until: jnp.ndarray  # (N, C) float32 ms — PRUNE backoff per directed edge
+    fmd: jnp.ndarray            # (N, C) float32 — firstMessageDeliveries counter
+    slow_penalty: jnp.ndarray   # (N, C) float32 — slowPeerPenalty accumulator
+    alive: jnp.ndarray          # (N,) bool — churn mask
+    subscribed: jnp.ndarray     # (N,) bool — topic membership
+    t_ms: jnp.ndarray           # () float32 — sim clock
+    key: jnp.ndarray            # jax PRNG key
+    # cumulative observability counters (reference L5)
+    grafts: jnp.ndarray         # () int32
+    prunes: jnp.ndarray         # () int32
+    bytes_tx: jnp.ndarray       # (N,) float32
+    bytes_rx: jnp.ndarray       # (N,) float32
+    dup_rx: jnp.ndarray         # (N,) int32
+    ihave_tx: jnp.ndarray      # () int64-ish int32
+    iwant_tx: jnp.ndarray      # () int32
+
+    def score(self, params: SimParams) -> jnp.ndarray:
+        """Peer score as seen across each directed edge (v1.1 subset:
+        P2 firstMessageDeliveries * weight + slow-peer penalty)."""
+        fmd = jnp.minimum(self.fmd, params.fmd_cap)
+        return params.fmd_weight * fmd - self.slow_penalty
+
+
+def init_state(params: SimParams, seed: int = 0) -> SimState:
+    import jax
+
+    params.validate()
+    n, c = params.n, params.capacity
+    return SimState(
+        mesh_mask=jnp.zeros((n, c), dtype=bool),
+        fanout_mask=jnp.zeros((n, c), dtype=bool),
+        backoff_until=jnp.zeros((n, c), dtype=jnp.float32),
+        fmd=jnp.zeros((n, c), dtype=jnp.float32),
+        slow_penalty=jnp.zeros((n, c), dtype=jnp.float32),
+        alive=jnp.ones((n,), dtype=bool),
+        subscribed=jnp.ones((n,), dtype=bool),
+        t_ms=jnp.asarray(0.0, dtype=jnp.float32),
+        key=jax.random.PRNGKey(seed),
+        grafts=jnp.asarray(0, dtype=jnp.int32),
+        prunes=jnp.asarray(0, dtype=jnp.int32),
+        bytes_tx=jnp.zeros((n,), dtype=jnp.float32),
+        bytes_rx=jnp.zeros((n,), dtype=jnp.float32),
+        dup_rx=jnp.zeros((n,), dtype=jnp.int32),
+        ihave_tx=jnp.asarray(0, dtype=jnp.int32),
+        iwant_tx=jnp.asarray(0, dtype=jnp.int32),
+    )
+
+
+def graph_arrays(graph) -> dict:
+    """Move a ConnGraph's arrays to device once (jnp constants per epoch)."""
+    return {
+        "conns": jnp.asarray(graph.conns),
+        "rev": jnp.asarray(graph.rev),
+        "out_mask": jnp.asarray(graph.out_mask),
+    }
+
+
+def topo_arrays(topology, payload_bytes: int) -> dict:
+    return {
+        "stage": jnp.asarray(topology.stage_of_peer),
+        "lat_ms": jnp.asarray(topology.latency_ms),
+        "tx_ms": jnp.asarray(
+            topology.tx_ms_per_peer(payload_bytes).astype(np.float32)
+        ),
+    }

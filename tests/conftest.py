@@ -12,6 +12,23 @@ os.environ.setdefault("JAX_ENABLE_X64", "0")
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
+# the package's env-var surface: cleared before every test so a developer's
+# shell exports (PEERS=..., GOSSIPSUB_D=...) can't leak into assertions
+_ENV_SURFACE_PREFIXES = ("GOSSIPSUB_",)
+_ENV_SURFACE = (
+    "PEERS", "CONNECTTO", "MUXER", "FRAGMENTS", "SHADOWENV", "SERVICE",
+    "MAXCONNECTIONS", "SELFTRIGGER", "PEER_ID_OFFSET", "FILEPATH",
+    "PUBLISHERS", "NODE_ROLE", "MOUNTSMIX", "USESMIX", "NUMMIX", "MIXD",
+    "PORT", "SIMBACKEND",
+)
+
+
+@pytest.fixture(autouse=True)
+def _hermetic_env(monkeypatch):
+    for var in list(os.environ):
+        if var in _ENV_SURFACE or var.startswith(_ENV_SURFACE_PREFIXES):
+            monkeypatch.delenv(var, raising=False)
+
 
 @pytest.fixture
 def rng():

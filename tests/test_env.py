@@ -71,6 +71,12 @@ def test_gossipsub_param_defaults():
     assert p.flood_publish
 
 
+def test_direct_construction_derives_defaults():
+    # derived defaults must follow base params on direct construction too
+    p = GossipSubParams(d=10, d_low=8, d_high=12)
+    assert p.d_score == 8 and p.d_out == 5 and p.d_lazy == 10
+
+
 def test_gossipsub_env_overrides(monkeypatch):
     monkeypatch.setenv("GOSSIPSUB_D", "8")
     monkeypatch.setenv("GOSSIPSUB_D_LOW", "6")

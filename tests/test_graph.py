@@ -1,0 +1,70 @@
+import numpy as np
+
+from dst_libp2p_test_node_tpu.ops.graph import (
+    build_connection_graph,
+    sample_dials,
+    _cumcount,
+)
+
+
+def test_cumcount():
+    keys = np.array([3, 1, 3, 3, 1, 2])
+    assert _cumcount(keys).tolist() == [0, 0, 1, 2, 1, 0]
+
+
+def test_sample_dials_small():
+    d = sample_dials(100, 10, seed=1)
+    assert d.shape == (100, 10)
+    for p in range(100):
+        row = d[p]
+        assert p not in row
+        assert len(set(row.tolist())) == 10
+
+
+def test_sample_dials_large_path():
+    d = sample_dials(5000, 10, seed=2)
+    assert d.shape == (5000, 10)
+    me = np.arange(5000)[:, None]
+    assert not (d == me).any()
+    # all distinct per row
+    srt = np.sort(d, axis=1)
+    assert not (srt[:, 1:] == srt[:, :-1]).any()
+
+
+def test_graph_reverse_map_and_symmetry():
+    g = build_connection_graph(200, 10, seed=3)
+    g.validate()
+    # symmetric: q in conns[p] <=> p in conns[q]
+    p, i = np.nonzero(g.conns >= 0)
+    q = g.conns[p, i]
+    for pp, qq in list(zip(p, q))[:500]:
+        assert pp in g.conns[qq]
+
+
+def test_degree_distribution():
+    g = build_connection_graph(1000, 10, seed=4)
+    # every peer dialed 10; expected degree ~ 20
+    assert g.degree.min() >= 10
+    assert abs(g.degree.mean() - 20.0) < 1.0
+
+
+def test_outbound_count():
+    g = build_connection_graph(300, 10, seed=5)
+    # each peer's outbound edges == its dials (minus dedup'd mutual dials)
+    out_deg = g.out_mask.sum(axis=1)
+    assert (out_deg <= 10).all()
+    assert out_deg.mean() > 9.0
+
+
+def test_max_degree_cap():
+    g = build_connection_graph(500, 10, seed=6, max_degree=16)
+    assert g.capacity == 16
+    assert g.degree.max() <= 16
+    g.validate()
+
+
+def test_determinism():
+    a = build_connection_graph(100, 5, seed=7)
+    b = build_connection_graph(100, 5, seed=7)
+    assert np.array_equal(a.conns, b.conns)
+    assert np.array_equal(a.rev, b.rev)

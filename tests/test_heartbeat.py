@@ -1,0 +1,106 @@
+import numpy as np
+import jax.numpy as jnp
+
+from dst_libp2p_test_node_tpu.ops.graph import build_connection_graph
+from dst_libp2p_test_node_tpu.ops.heartbeat import heartbeat_step, run_heartbeats
+from dst_libp2p_test_node_tpu.ops.state import SimParams, init_state, graph_arrays
+
+
+def make(n=100, connect_to=10, seed=0, **over):
+    g = build_connection_graph(n, connect_to, seed=seed)
+    params = SimParams(n=n, capacity=g.capacity, **over)
+    state = init_state(params, seed=seed)
+    arrs = graph_arrays(g)
+    return g, params, state, arrs
+
+
+def mesh_degrees(state):
+    return np.asarray(state.mesh_mask.sum(axis=-1))
+
+
+def test_mesh_forms_and_respects_bounds():
+    g, params, state, a = make()
+    state = run_heartbeats(state, a["conns"], a["rev"], a["out_mask"], params, 10)
+    deg = mesh_degrees(state)
+    # the invariant the reference's whole experiment rests on:
+    # D_low <= |mesh| <= D_high once the network stabilizes
+    assert (deg >= params.d_low).all(), deg.min()
+    assert (deg <= params.d_high).all(), deg.max()
+
+
+def test_mesh_is_symmetric():
+    g, params, state, a = make(n=80, connect_to=8)
+    state = run_heartbeats(state, a["conns"], a["rev"], a["out_mask"], params, 5)
+    mesh = np.asarray(state.mesh_mask)
+    p, i = np.nonzero(mesh)
+    q = g.conns[p, i]
+    j = g.rev[p, i]
+    assert mesh[q, j].all(), "mesh membership must be reciprocal"
+
+
+def test_mesh_subset_of_connections():
+    g, params, state, a = make()
+    state = run_heartbeats(state, a["conns"], a["rev"], a["out_mask"], params, 8)
+    mesh = np.asarray(state.mesh_mask)
+    assert not (mesh & (g.conns < 0)).any()
+
+
+def test_clock_advances_and_counters():
+    g, params, state, a = make(n=50, connect_to=6)
+    s1 = heartbeat_step(state, a["conns"], a["rev"], a["out_mask"], params)
+    assert float(s1.t_ms) == params.heartbeat_ms
+    assert int(s1.grafts) > 0  # first heartbeat grafts from empty mesh
+
+
+def test_churn_kills_and_mesh_recovers():
+    g, params, state, a = make(n=200, connect_to=10, churn_down_per_hb=0.0)
+    state = run_heartbeats(state, a["conns"], a["rev"], a["out_mask"], params, 5)
+    # kill 20% of peers manually, then heal
+    alive = np.ones(200, dtype=bool)
+    alive[::5] = False
+    state = state.replace(alive=jnp.asarray(alive))
+    state = run_heartbeats(state, a["conns"], a["rev"], a["out_mask"], params, 5)
+    mesh = np.asarray(state.mesh_mask)
+    # no live peer keeps a dead peer in its mesh
+    dead_nbr = ~alive[np.clip(g.conns, 0, None)] & (g.conns >= 0)
+    assert not (mesh[alive] & dead_nbr[alive]).any()
+    # live peers with enough live neighbors still hold the degree bound
+    deg = mesh.sum(axis=1)
+    live_deg_ok = deg[alive] >= params.d_low
+    assert live_deg_ok.mean() > 0.95
+
+
+def test_backoff_blocks_immediate_regraft():
+    # force an over-full mesh: graft everything, then one heartbeat must
+    # prune down to D and pruned edges must carry a backoff in the future
+    g, params, state, a = make(n=60, connect_to=12)
+    full = jnp.asarray(g.conns >= 0)
+    state = state.replace(mesh_mask=full)
+    s1 = heartbeat_step(state, a["conns"], a["rev"], a["out_mask"], params)
+    deg = mesh_degrees(s1)
+    assert (deg <= params.d_high).all()
+    pruned = np.asarray(full & ~s1.mesh_mask)
+    assert pruned.any()
+    bo = np.asarray(s1.backoff_until)
+    assert (bo[pruned] > float(s1.t_ms)).all()
+
+
+def test_prune_keeps_high_score_members():
+    g, params, state, a = make(n=40, connect_to=12)
+    full = g.conns >= 0
+    # edge-symmetric scores (both endpoints agree): score high iff the
+    # undirected edge's smaller endpoint id is divisible by 4
+    q = np.clip(g.conns, 0, None)
+    p = np.arange(40)[:, None]
+    hi_edge = (np.minimum(p, q) % 4 == 0) & full
+    fmd = jnp.asarray(np.where(hi_edge, 25.0, 0.0).astype(np.float32))
+    state = state.replace(mesh_mask=jnp.asarray(full), fmd=fmd)
+    s1 = heartbeat_step(state, a["conns"], a["rev"], a["out_mask"], params)
+    mesh = np.asarray(s1.mesh_mask)
+    pruned = full & ~mesh
+    kept = full & mesh
+    assert pruned.any() and kept.any()
+    # pruning keeps the D_score highest-scored members first, so surviving
+    # edges must outscore pruned ones on average
+    score = np.where(hi_edge, 25.0, 0.0)
+    assert score[kept].mean() > score[pruned].mean() + 1.0
