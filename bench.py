@@ -1,0 +1,110 @@
+"""Benchmark: simulated peers x heartbeat-rounds per second (metric of record).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline note (BASELINE.md): the reference publishes no numbers. The
+comparison constant below is the reference harness's *effective* simulation
+throughput: Shadow runs the canonical 100-peer GossipSub experiment (15 min of
+simulated time = 900 heartbeat rounds, shadow/topogen.py:82) in on the order
+of 100 s of wall time on one amd64 host — about 1e3 peer-rounds/s, and Shadow
+scales roughly linearly in process count. We benchmark the same workload
+shape (heartbeat mesh maintenance + periodic 15 KB message dissemination with
+IHAVE/IWANT gossip) at 100k peers on one chip.
+
+Run: JAX picks the best available backend (the real TPU chip under the
+driver; CPU elsewhere). Compile time is excluded (one warm-up call per traced
+shape), matching how the reference excludes image build time from run time.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+# Shadow's effective throughput on the canonical config (see module docstring)
+BASELINE_PEER_ROUNDS_PER_SEC = 1000.0
+
+N_PEERS = 100_000
+HB_ROUNDS = 300          # timed heartbeat rounds
+MESSAGES = 3             # timed dissemination fixpoints (one per ~100 rounds)
+
+
+def main() -> None:
+    import jax
+
+    from dst_libp2p_test_node_tpu.config.topology import Topology, TopoParams
+    from dst_libp2p_test_node_tpu.ops.disseminate import disseminate
+    from dst_libp2p_test_node_tpu.ops.graph import build_connection_graph
+    from dst_libp2p_test_node_tpu.ops.heartbeat import run_heartbeats
+    from dst_libp2p_test_node_tpu.ops.state import (
+        SimParams, graph_arrays, init_state,
+    )
+
+    topo = Topology.build(
+        TopoParams(
+            network_size=N_PEERS, anchor_stages=5, min_bandwidth=50,
+            max_bandwidth=150, min_latency=40, max_latency=130,
+            msg_size_bytes=15000,
+        )
+    )
+    graph = build_connection_graph(N_PEERS, 10, seed=0)
+    params = SimParams(n=N_PEERS, capacity=graph.capacity)
+    state = init_state(params, seed=0)
+    a = graph_arrays(graph)
+    import jax.numpy as jnp
+
+    stage = jnp.asarray(topo.stage_of_peer)
+    lat = jnp.asarray(topo.latency_ms)
+    bw = jnp.asarray(topo.bw_up_mbit)
+
+    def hb(s, k):
+        return run_heartbeats(s, a["conns"], a["rev"], a["out_mask"], params, k)
+
+    def publish(s, pub):
+        res, s = disseminate(
+            s, a["conns"], a["rev"], stage, lat, bw, publisher=pub,
+            t0_ms=s.t_ms, params=params, payload_bytes=15000,
+        )
+        return res, s
+
+    # warm-up: trace/compile both kernels (same shapes as the timed loop) and
+    # form the mesh
+    per_burst = HB_ROUNDS // MESSAGES
+    state = hb(state, per_burst)
+    res, state = publish(state, 4)
+    jax.block_until_ready(state.mesh_mask)
+    coverage = float(np.asarray(res.received).mean())
+
+    t0 = time.time()
+    for i in range(MESSAGES):
+        state = hb(state, per_burst)
+        res, state = publish(state, 4 + i)
+    jax.block_until_ready(state.mesh_mask)
+    wall = time.time() - t0
+
+    rounds = MESSAGES * per_burst
+    value = N_PEERS * rounds / wall
+    delays = np.asarray(res.delay_ms)
+    ok = delays < 1e30
+    out = {
+        "metric": "simulated_peer_rounds_per_sec",
+        "value": round(value, 1),
+        "unit": "peers*rounds/s",
+        "vs_baseline": round(value / BASELINE_PEER_ROUNDS_PER_SEC, 2),
+        "detail": {
+            "n_peers": N_PEERS,
+            "rounds": rounds,
+            "wall_s": round(wall, 3),
+            "backend": jax.default_backend(),
+            "coverage": coverage,
+            "p50_ms": float(np.percentile(delays[ok], 50)),
+            "p99_ms": float(np.percentile(delays[ok], 99)),
+        },
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

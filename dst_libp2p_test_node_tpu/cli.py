@@ -1,0 +1,245 @@
+"""CLI driver: the `SIMBACKEND=tpu` replacement for shadow/run.sh + topogen.py.
+
+Three subcommands:
+
+  topogen    — emit network_topology.gml + shadow.yaml. Accepts BOTH the
+               reference topogen's argparse flags (-n/-bl/-bh/...) and the 13
+               positional args shadow/run.sh actually passes (the reference's
+               two halves are out of sync — run.sh:49-50 sends positionals to
+               a flags-only parser; we accept either, SURVEY.md §7 quirks).
+  run        — the 14-positional-arg experiment driver mirroring
+               shadow/run.sh:23-38: generates the topology, runs the JAX
+               simulation N times, writes awk-compatible latencies<i> files
+               and prints the per-run summaries (small/large switch at
+               msg_size < 1000, run.sh:68-72).
+  summarize  — re-run the summary over an existing latencies file.
+
+Usage:
+  python -m dst_libp2p_test_node_tpu run 1 1000 15000 1 10 50 150 40 130 5 0.0 4 0 4000
+  python -m dst_libp2p_test_node_tpu topogen -n 100 -st 5 -bl 50 -bh 150
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+from .config.env import GossipSubParams, env_str
+from .config.topology import Topology, TopoParams
+
+# run.sh positional order (run.sh:23-38)
+RUN_SH_PARAMS = [
+    "runs", "nodes", "msg_size", "num_frag", "num_publishers",
+    "min_bandwidth", "max_bandwidth", "min_latency", "max_latency",
+    "anchor_stages", "packet_loss", "publisher_id", "publisher_rotation",
+    "inter_message_delay_ms",
+]
+# the 13 positionals run.sh hands to topogen (run.sh:49-50), in its order
+TOPOGEN_POSITIONALS = [
+    "nodes", "min_bandwidth", "max_bandwidth", "min_latency", "max_latency",
+    "anchor_stages", "packet_loss", "msg_size", "num_frag", "num_publishers",
+    "publisher_id", "publisher_rotation", "inter_message_delay_ms",
+]
+
+
+def _topo_flags(p: argparse.ArgumentParser) -> None:
+    """The reference topogen's flag surface (topogen.py:13-36)."""
+    p.add_argument("-n", "--network-size", type=int, default=100)
+    p.add_argument("-bl", "--min-bandwidth", type=int, default=50)
+    p.add_argument("-bh", "--max-bandwidth", type=int, default=50)
+    p.add_argument("-ll", "--min-latency", type=int, default=100)
+    p.add_argument("-lh", "--max-latency", type=int, default=100)
+    p.add_argument("-st", "--anchor-stages", type=int, default=1)
+    p.add_argument("-l", "--packet-loss", type=float, default=0.0)
+    p.add_argument("-s", "--msg-size-bytes", type=int, default=1500)
+    p.add_argument("-f", "--num-frags", type=int, choices=range(1, 10), default=1)
+    p.add_argument("-m", "--messages", type=int, default=10)
+    p.add_argument("-d", "--delay-seconds", type=float, default=0.1)
+    p.add_argument(
+        "-mx", "--muxer", choices=["mplex", "yamux", "quic"], default="yamux"
+    )
+
+
+def _params_from_flags(a) -> TopoParams:
+    return TopoParams(
+        network_size=a.network_size,
+        min_bandwidth=a.min_bandwidth,
+        max_bandwidth=a.max_bandwidth,
+        min_latency=a.min_latency,
+        max_latency=a.max_latency,
+        anchor_stages=a.anchor_stages,
+        packet_loss=a.packet_loss,
+        msg_size_bytes=a.msg_size_bytes,
+        num_frags=a.num_frags,
+        messages=a.messages,
+        delay_seconds=a.delay_seconds,
+        muxer=a.muxer,
+    )
+
+
+def _topo_from_fields(m: dict, muxer: str = "yamux") -> TopoParams:
+    """One place owns the run.sh-field -> TopoParams contract (both the
+    `topogen` positional form and the `run` driver feed through here)."""
+    return TopoParams(
+        network_size=int(m["nodes"]),
+        min_bandwidth=int(m["min_bandwidth"]),
+        max_bandwidth=int(m["max_bandwidth"]),
+        min_latency=int(m["min_latency"]),
+        max_latency=int(m["max_latency"]),
+        anchor_stages=int(m["anchor_stages"]),
+        packet_loss=float(m["packet_loss"]),
+        msg_size_bytes=int(m["msg_size"]),
+        num_frags=int(m["num_frag"]),
+        messages=int(m["num_publishers"]),
+        delay_seconds=float(m["inter_message_delay_ms"]) / 1000.0,
+        muxer=muxer,
+    )
+
+
+def _params_from_positionals(vals: list[str]) -> tuple[TopoParams, dict]:
+    m = dict(zip(TOPOGEN_POSITIONALS, vals))
+    extra = {
+        "publisher_id": int(m["publisher_id"]),
+        "publisher_rotation": bool(int(m["publisher_rotation"])),
+    }
+    return _topo_from_fields(m), extra
+
+
+def cmd_topogen(argv: list[str]) -> int:
+    if argv and not argv[0].startswith("-"):
+        if len(argv) != 13:
+            print(
+                f"topogen: expected 13 positional args ({' '.join(TOPOGEN_POSITIONALS)}) "
+                f"or flag form, got {len(argv)}",
+                file=sys.stderr,
+            )
+            return 2
+        topo, _ = _params_from_positionals(argv)
+    else:
+        p = argparse.ArgumentParser(prog="topogen")
+        _topo_flags(p)
+        topo = _params_from_flags(p.parse_args(argv))
+    t = Topology.build(topo)
+    t.write_gml()
+    t.write_shadow_yaml()
+    print(f"wrote network_topology.gml + shadow.yaml ({topo.network_size} peers, "
+          f"{topo.anchor_stages} stages)")
+    return 0
+
+
+def cmd_run(argv: list[str]) -> int:
+    # flags appended after the 14 positionals tune the TPU backend
+    p = argparse.ArgumentParser(
+        prog="run",
+        usage="run <runs> <nodes> <message_size> <num_fragment> <num_publishers> "
+        "<min_bandwidth> <max_bandwidth> <min_latency> <max_latency> "
+        "<anchor_stages> <packet_loss> <publisher_id> <publisher_rotation> "
+        "<inter_message_delay> [--seed N] [--warmup-s S] ...",
+    )
+    for name in RUN_SH_PARAMS:
+        p.add_argument(name)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--warmup-s", type=float, default=500.0)
+    p.add_argument("--connect-to", type=int, default=10)  # run.sh:38
+    p.add_argument("--muxer", choices=["mplex", "yamux", "quic"], default="yamux")
+    p.add_argument("--no-gossip", action="store_true")
+    p.add_argument("--churn", type=float, default=0.0,
+                   help="per-heartbeat down-probability (failure injection)")
+    p.add_argument("--out-prefix", default="")
+    p.add_argument("--stats-json", action="store_true",
+                   help="also write stats<i>.json next to latencies<i>")
+    a = p.parse_args(argv)
+
+    from .runtime.simulator import ExperimentConfig, Simulator
+    from .runtime.summarize import report
+
+    topo = _topo_from_fields(vars(a), muxer=a.muxer)
+    t = Topology.build(topo)
+    t.write_gml(a.out_prefix + "network_topology.gml")
+    t.write_shadow_yaml(a.out_prefix + "shadow.yaml")
+
+    large = topo.msg_size_bytes >= 1000
+    for i in range(1, int(a.runs) + 1):
+        print(f"Running for turn {i}")
+        cfg = ExperimentConfig(
+            topo=topo,
+            connect_to=a.connect_to,
+            gossipsub=GossipSubParams(),
+            publisher_id=int(a.publisher_id),
+            publisher_rotation=bool(int(a.publisher_rotation)),
+            warmup_s=a.warmup_s,
+            seed=a.seed + i - 1,
+            with_gossip=not a.no_gossip,
+            churn_down_per_hb=a.churn,
+            churn_up_per_hb=a.churn / 2 if a.churn else 0.0,
+        )
+        t0 = time.time()
+        sim = Simulator(cfg, topology=t)
+        sim.run()
+        wall = time.time() - t0
+        n_lines = sim.write_latencies(f"{a.out_prefix}latencies{i}")
+        s = sim.summary(large)
+        print(f"Summary for turn {i}")
+        print(report(s, large=large), end="")
+        print(
+            f"[tpu backend] wall={wall:.2f}s "
+            f"peers*rounds/s={sim.peer_rounds_per_sec(wall):.0f} "
+            f"lines={n_lines}"
+        )
+        if a.stats_json:
+            with open(f"{a.out_prefix}stats{i}.json", "w") as f:
+                json.dump(
+                    {
+                        "network_size": s.network_size,
+                        "coverage": s.coverage(),
+                        "max_latency_ms": s.max_latency_ms,
+                        "avg_latency_ms": s.avg_latency_ms,
+                        "avg_max_latency_ms": s.avg_max_latency_ms,
+                        "wall_s": wall,
+                        "peer_rounds_per_sec": sim.peer_rounds_per_sec(wall),
+                    },
+                    f,
+                    indent=2,
+                )
+    return 0
+
+
+def cmd_summarize(argv: list[str]) -> int:
+    p = argparse.ArgumentParser(prog="summarize")
+    p.add_argument("path")
+    p.add_argument("--large", action="store_true")
+    a = p.parse_args(argv)
+    from .runtime.summarize import report, summarize_file
+
+    print(report(summarize_file(a.path, large=a.large), large=a.large), end="")
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv:
+        print(__doc__)
+        return 2
+    cmd, rest = argv[0], argv[1:]
+    backend = env_str("SIMBACKEND", "tpu")
+    if cmd == "topogen":
+        return cmd_topogen(rest)
+    if cmd == "run":
+        if backend.lower() not in ("tpu", "jax"):
+            print(
+                f"SIMBACKEND={backend} is not provided by this package "
+                "(use the reference's shadow/ harness for the shadow backend)",
+                file=sys.stderr,
+            )
+            return 2
+        return cmd_run(rest)
+    if cmd == "summarize":
+        return cmd_summarize(rest)
+    print(f"unknown command: {cmd}\n{__doc__}", file=sys.stderr)
+    return 2
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

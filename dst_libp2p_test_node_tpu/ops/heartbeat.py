@@ -128,6 +128,7 @@ def heartbeat_step(
     )
 
 
+@partial(jax.jit, static_argnames=("params", "steps"))
 def run_heartbeats(
     state: SimState,
     conns: jnp.ndarray,
@@ -137,7 +138,10 @@ def run_heartbeats(
     steps: int,
 ) -> SimState:
     """lax.scan over heartbeat rounds — simulated time scales in rounds with
-    no host sync (the reference's 'long simulated time' axis, SURVEY.md §5)."""
+    no host sync (the reference's 'long simulated time' axis, SURVEY.md §5).
+
+    Jitted with static `steps` so repeated same-length segments (the
+    simulator's inter-message gaps) hit the compile cache."""
 
     def body(s, _):
         return heartbeat_step(s, conns, rev, out_mask, params), None

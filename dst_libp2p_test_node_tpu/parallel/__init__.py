@@ -1,0 +1,1 @@
+from .sharding import make_peer_mesh, shard_simulation, peer_sharding, replicated  # noqa: F401

@@ -1,0 +1,59 @@
+"""Peer-axis sharding: the framework's scale-out story.
+
+The reference scales by spawning more OS processes (one per peer) across
+Shadow workers or K8s nodes; its cross-peer traffic rides TCP/QUIC sockets
+(SURVEY.md §2 parallelism table). Here the peer axis IS the parallel axis:
+every (N, ...) state array shards across TPU chips over a 1-D
+`jax.sharding.Mesh` ("peers"), cross-shard mesh edges become XLA collectives
+over ICI (gathers through the neighbor index arrays), and multi-host scales
+the same mesh over DCN. This is the context-parallel analog the north star
+asks for: the 1M-peer adjacency node-sharded across a v5e-8.
+
+Latency/stage constants stay replicated (they are (S+1)^2-tiny); per-peer
+rows shard on axis 0. XLA inserts the all-gathers for neighbor lookups; the
+explicit shard_map + all_to_all bucketing lives in parallel/exchange.py for
+the hand-tuned path.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_peer_mesh(n_devices: int | None = None) -> Mesh:
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return Mesh(np.array(devs), ("peers",))
+
+
+def peer_sharding(mesh: Mesh) -> NamedSharding:
+    """Rows of any (N, ...) peer-major array shard across the mesh."""
+    return NamedSharding(mesh, P("peers"))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_simulation(state, arrays: dict, topo: dict, mesh: Mesh):
+    """Place SimState + graph/topology arrays: peer-major rows sharded,
+    scalars/clock/key and the tiny stage matrices replicated."""
+    rows = peer_sharding(mesh)
+    rep = replicated(mesh)
+
+    def place_state(path, x):
+        x = jax.numpy.asarray(x)
+        if x.ndim >= 1 and x.shape[0] == state.mesh_mask.shape[0]:
+            return jax.device_put(x, rows)
+        return jax.device_put(x, rep)
+
+    state = jax.tree_util.tree_map_with_path(place_state, state)
+    arrays = {k: jax.device_put(v, rows) for k, v in arrays.items()}
+    topo_placed = {}
+    for k, v in topo.items():
+        sh = rows if (v.ndim >= 1 and v.shape[0] == state.mesh_mask.shape[0]) else rep
+        topo_placed[k] = jax.device_put(v, sh)
+    return state, arrays, topo_placed

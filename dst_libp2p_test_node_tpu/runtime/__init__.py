@@ -1,0 +1,2 @@
+from .simulator import Simulator, ExperimentConfig, MessageRecord  # noqa: F401
+from .summarize import summarize, summarize_file, report, LatencySummary  # noqa: F401

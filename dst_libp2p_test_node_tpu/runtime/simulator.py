@@ -1,0 +1,216 @@
+"""Experiment runtime: what `shadow shadow.yaml` does for the reference.
+
+Shadow spawns one libp2p process per host, lets them boot (nodes start t=5 s),
+dial, and stabilize their meshes, then a publisher controller injects messages
+from t=500 s at a fixed inter-message delay (shadow/topogen.py:79-136,
+run.sh:58-64). The Simulator replays that timeline against the JAX engine:
+
+  boot     -> connection graph build (ops/graph.py)
+  warm-up  -> `warmup_s` heartbeats of mesh maintenance (lax.scan)
+  inject   -> one disseminate() fixpoint per message, heartbeats advancing
+              between messages at the configured spacing
+  output   -> awk-compatible latencies lines (runtime/logemit.py) + summary
+              (runtime/summarize.py)
+
+Publisher selection mirrors run.sh's publisher_id / publisher_rotation
+(run.sh:34-35); SELFTRIGGER controls whether the publisher logs its own
+delivery (main.nim:245: triggerSelf). The muxer choice collapses to a
+per-hop processing-delay constant (SURVEY.md §5: yamux vs quic differ in
+handshake/stream overhead, not steady-state routing).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..config.env import GossipSubParams
+from ..config.topology import Topology, TopoParams
+from ..ops.disseminate import disseminate
+from ..ops.graph import build_connection_graph
+from ..ops.heartbeat import run_heartbeats
+from ..ops.state import SimParams, graph_arrays, init_state
+from .logemit import LatenciesWriter
+from .summarize import LatencySummary, report, summarize
+
+# steady-state per-hop processing cost by muxer (validation + framing; the
+# transports differ only in handshake/stream constants, SURVEY.md §5)
+MUXER_PROC_MS = {"yamux": 2.0, "mplex": 2.2, "quic": 1.5}
+
+_INF_CUTOFF = 1e30
+
+
+@dataclass
+class ExperimentConfig:
+    topo: TopoParams = field(default_factory=TopoParams)
+    connect_to: int = 10              # CONNECTTO (run.sh:38 fixes 10)
+    gossipsub: GossipSubParams = field(default_factory=GossipSubParams)
+    publisher_id: int = 4             # run.sh:34
+    publisher_rotation: bool = False  # run.sh:35
+    warmup_s: float = 500.0           # injector start_time (topogen.py:130)
+    self_trigger: bool = True         # SELFTRIGGER (main.nim:245)
+    max_connections: int = 250        # MAXCONNECTIONS (main.nim:429)
+    seed: int = 0
+    with_gossip: bool = True
+    churn_down_per_hb: float = 0.0
+    churn_up_per_hb: float = 0.0
+
+
+@dataclass
+class MessageRecord:
+    msg_id: int
+    publisher: int
+    t0_ms: float
+    delays_ms: np.ndarray         # (N,) float, inf = never received
+    received: np.ndarray          # (N,) bool
+    sends: np.ndarray
+    copies_rx: np.ndarray
+    ihave: int
+    iwant: int
+
+    @property
+    def receivers(self) -> np.ndarray:
+        return np.nonzero(self.received)[0]
+
+    @property
+    def delays_ms_int(self) -> np.ndarray:
+        """Integer milliseconds as the reference logs them
+        (inMilliseconds truncates, main.nim:150)."""
+        return self.delays_ms[self.received].astype(np.int64)
+
+
+class Simulator:
+    def __init__(self, cfg: ExperimentConfig, topology: Topology | None = None):
+        import jax.numpy as jnp
+
+        cfg.topo.validate()
+        cfg.gossipsub.validate()
+        self.cfg = cfg
+        self.topology = topology or Topology.build(cfg.topo)
+        n = cfg.topo.network_size
+        self.graph = build_connection_graph(
+            n,
+            cfg.connect_to,
+            seed=cfg.seed,
+            max_degree=min(cfg.max_connections, max(4 * cfg.connect_to, 16)),
+        )
+        proc_ms = MUXER_PROC_MS.get(cfg.topo.muxer.lower(), 2.0)
+        self.params = SimParams.from_gossipsub(
+            n,
+            self.graph.capacity,
+            cfg.gossipsub,
+            proc_delay_ms=proc_ms,
+            churn_down_per_hb=cfg.churn_down_per_hb,
+            churn_up_per_hb=cfg.churn_up_per_hb,
+        )
+        self.state = init_state(self.params, seed=cfg.seed)
+        self.arrays = graph_arrays(self.graph)
+        self._stage = jnp.asarray(self.topology.stage_of_peer)
+        self._lat = jnp.asarray(self.topology.latency_ms)
+        self._bw = jnp.asarray(self.topology.bw_up_mbit)
+        self._msg_rng = np.random.default_rng(cfg.seed ^ 0x6D736749)  # msgId stream
+        self._hb_carry_ms = 0.0
+        self.records: list[MessageRecord] = []
+
+    # ---------------------------------------------------------------- phases
+
+    def advance(self, ms: float) -> None:
+        """Advance simulated time by `ms`, running the heartbeats due."""
+        self._hb_carry_ms += ms
+        hb = self.params.heartbeat_ms
+        steps = int(self._hb_carry_ms // hb)
+        self._hb_carry_ms -= steps * hb
+        if steps > 0:
+            a = self.arrays
+            self.state = run_heartbeats(
+                self.state, a["conns"], a["rev"], a["out_mask"], self.params, steps
+            )
+
+    def warmup(self) -> None:
+        self.advance(self.cfg.warmup_s * 1000.0)
+
+    def publish(self, publisher: int, msg_size: int | None = None) -> MessageRecord:
+        """Inject one message at the current sim time (the /publish path)."""
+        cfg = self.cfg
+        size = msg_size if msg_size is not None else cfg.topo.msg_size_bytes
+        a = self.arrays
+        res, self.state = disseminate(
+            self.state,
+            a["conns"],
+            a["rev"],
+            self._stage,
+            self._lat,
+            self._bw,
+            publisher=publisher,
+            t0_ms=float(self.state.t_ms) + self._hb_carry_ms,
+            params=self.params,
+            payload_bytes=size,
+            fragments=cfg.topo.num_frags,
+            with_gossip=cfg.with_gossip,
+        )
+        delays = np.asarray(res.delay_ms, dtype=np.float64)
+        received = np.asarray(res.received).copy()
+        if not cfg.self_trigger:
+            received[publisher] = False  # publisher doesn't log its own message
+        delays = np.where(received, delays, np.inf)
+        rec = MessageRecord(
+            msg_id=int(self._msg_rng.integers(0, 2**63, dtype=np.int64)),
+            publisher=publisher,
+            t0_ms=float(self.state.t_ms) + self._hb_carry_ms,
+            delays_ms=delays,
+            received=received,
+            sends=np.asarray(res.sends),
+            copies_rx=np.asarray(res.copies_rx),
+            ihave=int(res.ihave_sent),
+            iwant=int(res.iwant_sent),
+        )
+        self.records.append(rec)
+        return rec
+
+    def run(self) -> list[MessageRecord]:
+        """Full experiment: warm-up, then the injection schedule."""
+        cfg = self.cfg
+        self.warmup()
+        n = cfg.topo.network_size
+        delay_ms = cfg.topo.delay_seconds * 1000.0
+        pub = cfg.publisher_id % n
+        for i in range(cfg.topo.messages):
+            if i > 0:
+                self.advance(delay_ms)
+            self.publish(pub)
+            if cfg.publisher_rotation:
+                pub = (pub + 1) % n  # next message from the next peer (run.sh:16-17)
+        return self.records
+
+    # --------------------------------------------------------------- outputs
+
+    def latencies_writer(self) -> LatenciesWriter:
+        w = LatenciesWriter()
+        for rec in self.records:
+            w.add_message(rec.msg_id, rec.receivers, rec.delays_ms_int)
+        return w
+
+    def write_latencies(self, path: str) -> int:
+        return self.latencies_writer().write(path)
+
+    def summary(self, large: bool | None = None) -> LatencySummary:
+        if large is None:
+            large = self.cfg.topo.msg_size_bytes >= 1000  # run.sh:68 switch
+        w = self.latencies_writer()
+        import io
+
+        buf = io.StringIO()
+        w.write_to(buf)
+        return summarize(buf.getvalue().splitlines(), large=large)
+
+    def summary_report(self) -> str:
+        large = self.cfg.topo.msg_size_bytes >= 1000
+        return report(self.summary(large), large=large)
+
+    # ------------------------------------------------------------ statistics
+
+    def peer_rounds_per_sec(self, wall_seconds: float) -> float:
+        """The metric of record: simulated peers x heartbeat-rounds / wall s."""
+        sim_rounds = (float(self.state.t_ms)) / self.params.heartbeat_ms
+        return self.cfg.topo.network_size * sim_rounds / max(wall_seconds, 1e-9)

@@ -1,0 +1,152 @@
+"""Latency-summary statistics: the reference awk pipeline, reimplemented.
+
+Computes exactly what shadow/summary_latency.awk (small messages) and
+shadow/summary_latency_large.awk (>=1000 B messages, run.sh:68-72 switch)
+compute from a `latencies<i>` file:
+
+  - network-wide MAX and average latency over all receive lines;
+  - per message: average latency, receive count ("coverage", should == PEERS)
+    and the hop-spread histogram with hop_lat = 100 ms buckets
+    (summary_latency.awk:8,39); the large variant first rounds each receive
+    time to the nearest 100 ms because transmit time inflates latency for big
+    messages (summary_latency_large.awk:23-24);
+  - large variant: per-message MAX dissemination latency and the average of
+    per-message maxima — the p99-style headline stat (BASELINE.md).
+
+Output is both a structured dict (for programmatic gates) and a text report
+in the awk scripts' layout. The reference awk scripts themselves also run
+unchanged on our latencies files — that is covered by tests running real awk.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+
+HOP_LAT_MS = 100  # "should be consistent with shadow.yaml" (summary_latency.awk:8)
+
+# grep-style line: <path>:<lineno>:<msgId> milliseconds: <ms>
+# accept both peer<i> (awk-compatible) and pod-<i> (reference topogen) naming
+_LINE = re.compile(
+    r"(?:peer|pod-)(\d+)/main[^:]*:(\d+):(\d+) milliseconds: (-?\d+)\s*$"
+)
+
+
+@dataclass
+class MessageSummary:
+    msg_id: int
+    avg_latency_ms: float
+    received: int
+    max_latency_ms: int
+    spread: dict[int, int] = field(default_factory=dict)  # bucket -> count
+
+
+@dataclass
+class LatencySummary:
+    network_size: int             # max peer ordinal seen (awk's Total Nodes)
+    total_messages: int
+    max_latency_ms: int           # network-wide max
+    avg_latency_ms: float         # network-wide average over all lines
+    messages: list[MessageSummary]
+    avg_max_latency_ms: float     # average of per-message maxima (large variant)
+
+    def coverage(self) -> float:
+        if not self.messages:
+            return 0.0
+        return sum(m.received for m in self.messages) / len(self.messages)
+
+
+def parse_latencies(lines) -> tuple[list[tuple[int, int, int]], int]:
+    """-> ([(peer_id, msg_id, delay_ms)], total_line_count) — non-matching
+    rows are skipped like the awk numeric-$3 filter (summary_latency.awk:12-14)
+    but still counted, because the awk's network-wide Average divides by NR
+    (ALL lines, including any BW rows grep captured; summary_latency.awk:29)."""
+    out = []
+    total = 0
+    for line in lines:
+        total += 1
+        m = _LINE.search(line)
+        if m:
+            out.append((int(m.group(1)), int(m.group(3)), int(m.group(4))))
+    return out, total
+
+
+def summarize(lines, large: bool = False) -> LatencySummary:
+    rows, total_lines = parse_latencies(lines)
+    if not rows:
+        return LatencySummary(0, 0, 0, 0.0, [], 0.0)
+    network_size = max(r[0] for r in rows)
+    delays = [r[2] for r in rows]
+    by_msg: dict[int, list[int]] = {}
+    for _, mid, d in rows:
+        by_msg.setdefault(mid, []).append(d)
+
+    messages = []
+    for mid, ds in by_msg.items():
+        if large:
+            # round receive times to the nearest hop_lat before bucketing
+            # (summary_latency_large.awk:24); the per-message average is over
+            # the ROUNDED times in the large variant (awk:48)
+            rounded = [int(d / HOP_LAT_MS + 0.5) * HOP_LAT_MS for d in ds]
+            spread_src = rounded
+            avg = sum(rounded) / len(rounded)
+        else:
+            spread_src = ds
+            avg = sum(ds) / len(ds)
+        spread: dict[int, int] = {}
+        for d in spread_src:
+            # awk overwrites rather than accumulates the bucket with the last
+            # (key,count) pair it visits; we accumulate — a deliberate fix,
+            # noted so golden comparisons use counts from our parser only
+            b = d // HOP_LAT_MS
+            spread[b] = spread.get(b, 0) + 1
+        messages.append(
+            MessageSummary(
+                msg_id=mid,
+                avg_latency_ms=avg,
+                received=len(ds),
+                max_latency_ms=max(ds),
+                spread=spread,
+            )
+        )
+
+    avg_max = sum(m.max_latency_ms for m in messages) / len(messages)
+    return LatencySummary(
+        network_size=network_size,
+        total_messages=len(messages),
+        max_latency_ms=max(delays),
+        avg_latency_ms=sum(delays) / total_lines,  # awk divides by NR
+        messages=messages,
+        avg_max_latency_ms=avg_max,
+    )
+
+
+def report(s: LatencySummary, large: bool = False) -> str:
+    """Text report in the awk scripts' layout."""
+    n_spread = 54 if large else 7
+    out = [
+        f"Total Nodes :  {s.network_size} Total Messages Published :  "
+        f"{s.total_messages} Network Latency\t MAX :  {s.max_latency_ms} "
+        f"\tAverage :  {s.avg_latency_ms:g}",
+        "   Message ID \t       Avg Latency \t Messages Received",
+    ]
+    for m in s.messages:
+        spread = " ".join(
+            str(m.spread.get(b, 0)) for b in range(1, n_spread + 1)
+        )
+        out.append(
+            f"{m.msg_id} \t {m.avg_latency_ms:g} \t   {m.received} spread is {spread}"
+        )
+    if large:
+        for m in s.messages:
+            out.append(f"MAX delay for  {m.msg_id} is \t {m.max_latency_ms}")
+        out.append(
+            f"Total Messages Published :  {s.total_messages} "
+            f"Average Max Message Dissemination Latency :  {s.avg_max_latency_ms:g}"
+        )
+    return "\n".join(out) + "\n"
+
+
+def summarize_file(path: str, large: bool = False) -> LatencySummary:
+    with open(path) as f:
+        return summarize(f, large=large)

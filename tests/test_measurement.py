@@ -1,0 +1,143 @@
+"""Measurement-parity tests: latency lines, awk compatibility, summarizer."""
+
+import io
+import os
+import shutil
+import subprocess
+
+import numpy as np
+import pytest
+
+from dst_libp2p_test_node_tpu.runtime.logemit import LatenciesWriter, stdout_line
+from dst_libp2p_test_node_tpu.runtime.native_logemit import format_block
+from dst_libp2p_test_node_tpu.runtime.summarize import (
+    parse_latencies,
+    report,
+    summarize,
+)
+
+REF_AWK_SMALL = "/root/reference/shadow/summary_latency.awk"
+REF_AWK_LARGE = "/root/reference/shadow/summary_latency_large.awk"
+
+
+def test_stdout_line_format():
+    # main.nim:150: echo msgId, " milliseconds: ", delay
+    assert stdout_line(12345, 250) == "12345 milliseconds: 250"
+
+
+def test_grep_line_awk_split_contract():
+    w = LatenciesWriter()
+    w.add_message(777, np.array([3, 12]), np.array([100, 250]))
+    buf = io.StringIO()
+    w.write_to(buf)
+    lines = buf.getvalue().splitlines()
+    assert lines[0] == "shadow.data/hosts/peer3/main.1000.stdout:1:777 milliseconds: 100"
+    # the awk split "peer|/main|:.*:" must yield arr[2]=peer, arr[4]=msgId
+    import re
+
+    parts = re.split(r"peer|/main|:.*:", lines[1].split(" ")[0])
+    assert parts[1] == "12"
+    assert parts[3] == "777"
+
+
+def test_linenos_increment_per_peer():
+    w = LatenciesWriter()
+    w.add_message(1, np.array([5]), np.array([10]))
+    w.add_message(2, np.array([5]), np.array([20]))
+    buf = io.StringIO()
+    w.write_to(buf)
+    lines = buf.getvalue().splitlines()
+    assert ":1:1 milliseconds: 10" in lines[0]
+    assert ":2:2 milliseconds: 20" in lines[1]
+
+
+def test_parse_accepts_peer_and_pod_naming():
+    rows, total = parse_latencies([
+        "shadow.data/hosts/peer7/main.1000.stdout:3:99 milliseconds: 140",
+        "shadow.data/hosts/pod-8/main.1000.stdout:1:99 milliseconds: 150",
+        "garbage line",
+        "shadow.data/hosts/peer1/main.1000.stdout:1:99 milliseconds: notanum",
+    ])
+    assert rows == [(7, 99, 140), (8, 99, 150)]
+    assert total == 4  # awk's NR counts every line (its Average divides by NR)
+
+
+def test_summarize_small():
+    lines = []
+    w = LatenciesWriter()
+    w.add_message(42, np.array([1, 2, 3]), np.array([50, 150, 250]))
+    w.add_message(43, np.array([1, 2]), np.array([100, 300]))
+    buf = io.StringIO()
+    w.write_to(buf)
+    s = summarize(buf.getvalue().splitlines(), large=False)
+    assert s.network_size == 3
+    assert s.total_messages == 2
+    assert s.max_latency_ms == 300
+    assert s.avg_latency_ms == pytest.approx((50 + 150 + 250 + 100 + 300) / 5)
+    m42 = next(m for m in s.messages if m.msg_id == 42)
+    assert m42.received == 3
+    assert m42.avg_latency_ms == pytest.approx(150.0)
+    assert m42.spread == {0: 1, 1: 1, 2: 1}
+
+
+def test_summarize_large_rounds_to_hop():
+    lines = [
+        f"shadow.data/hosts/peer{p}/main.1000.stdout:1:9 milliseconds: {d}"
+        for p, d in [(1, 149), (2, 151), (3, 250)]
+    ]
+    s = summarize(lines, large=True)
+    m = s.messages[0]
+    # 149 -> 100, 151 -> 200, 250 -> 300 (nearest-100 rounding, awk:24)
+    assert m.avg_latency_ms == pytest.approx((100 + 200 + 300) / 3)
+    assert m.spread == {1: 1, 2: 1, 3: 1}
+    assert m.max_latency_ms == 250
+    assert s.avg_max_latency_ms == 250
+
+
+@pytest.mark.skipif(
+    not (shutil.which("awk") and os.path.exists(REF_AWK_SMALL)),
+    reason="reference awk scripts not available",
+)
+def test_reference_awk_runs_unchanged_on_our_output(tmp_path):
+    """The compatibility gate: the REFERENCE summary awk scripts consume our
+    latencies file and agree with our summarizer's numbers."""
+    rng = np.random.default_rng(0)
+    w = LatenciesWriter()
+    ids = [111111, 222222]
+    for mid in ids:
+        peers = np.arange(1, 50)
+        delays = rng.integers(40, 700, size=49)
+        w.add_message(mid, peers, delays)
+    path = str(tmp_path / "latencies1")
+    w.write(path)
+
+    with open(path) as f:
+        ours = summarize(f, large=True)
+
+    out = subprocess.run(
+        ["awk", "-f", REF_AWK_LARGE, path], capture_output=True, text=True
+    ).stdout
+    assert f"Total Nodes :  {ours.network_size}" in out
+    assert f"Total Messages Published :  {ours.total_messages}" in out
+    assert f"MAX :  {ours.max_latency_ms}" in out
+    for m in ours.messages:
+        assert f"MAX delay for  {m.msg_id} is \t {m.max_latency_ms}" in out
+    # avg-of-max headline stat matches to awk's %g printing
+    assert f"Average Max Message Dissemination Latency :  {ours.avg_max_latency_ms:g}" in out
+
+    out_small = subprocess.run(
+        ["awk", "-f", REF_AWK_SMALL, path], capture_output=True, text=True
+    ).stdout
+    small = summarize(open(path), large=False)
+    for m in small.messages:
+        # awk prints "value \t avg \t   count spread is ..."
+        assert f"{m.msg_id} \t {m.avg_latency_ms:g} \t   {m.received} spread is" in out_small
+
+
+def test_native_and_python_formatters_agree():
+    peers = np.arange(1, 6000)
+    linenos = np.ones(5999, dtype=np.int64)
+    delays = np.arange(5999, dtype=np.int64) % 999
+    py = format_block(424242, peers, linenos, delays, force_python=True)
+    native = format_block(424242, peers, linenos, delays)
+    assert py == native

@@ -1,0 +1,125 @@
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from dst_libp2p_test_node_tpu.config.topology import TopoParams
+from dst_libp2p_test_node_tpu.runtime.simulator import ExperimentConfig, Simulator
+
+BASE = TopoParams(
+    network_size=100, min_bandwidth=50, max_bandwidth=150,
+    min_latency=40, max_latency=130, anchor_stages=5,
+    msg_size_bytes=15000, messages=3, delay_seconds=4.0,
+)
+
+
+def small_cfg(**over):
+    kw = dict(topo=BASE, warmup_s=30.0, seed=0)
+    kw.update(over)
+    return ExperimentConfig(**kw)
+
+
+def test_full_experiment_coverage_and_summary():
+    sim = Simulator(small_cfg())
+    recs = sim.run()
+    assert len(recs) == 3
+    for r in recs:
+        assert r.received.sum() == 100
+        assert r.delays_ms[r.publisher] == 0.0
+    s = sim.summary()
+    assert s.total_messages == 3
+    assert s.coverage() == 100.0
+    assert s.network_size == 99
+    assert 40 <= s.avg_max_latency_ms <= 2000
+
+
+def test_publisher_rotation():
+    sim = Simulator(small_cfg(publisher_rotation=True, publisher_id=4))
+    recs = sim.run()
+    assert [r.publisher for r in recs] == [4, 5, 6]
+
+
+def test_self_trigger_off_excludes_publisher():
+    sim = Simulator(small_cfg(self_trigger=False))
+    recs = sim.run()
+    for r in recs:
+        assert not r.received[r.publisher]
+        assert r.received.sum() == 99
+
+
+def test_time_advances_with_schedule():
+    sim = Simulator(small_cfg())
+    sim.run()
+    # 30 s warmup + 2 * 4 s gaps = 38 s of heartbeats
+    assert float(sim.state.t_ms) == pytest.approx(38_000.0, abs=1001)
+
+
+def test_msg_ids_unique_and_deterministic():
+    a = Simulator(small_cfg())
+    b = Simulator(small_cfg())
+    ids_a = [r.msg_id for r in a.run()]
+    ids_b = [r.msg_id for r in b.run()]
+    assert ids_a == ids_b
+    assert len(set(ids_a)) == 3
+
+
+def test_latencies_file_roundtrip(tmp_path):
+    sim = Simulator(small_cfg())
+    sim.run()
+    path = str(tmp_path / "latencies1")
+    n = sim.write_latencies(path)
+    assert n == 300
+    from dst_libp2p_test_node_tpu.runtime.summarize import summarize_file
+
+    s = summarize_file(path, large=True)
+    assert s.coverage() == 100.0
+
+
+def test_cli_run_end_to_end(tmp_path):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH="/root/repo")
+    out = subprocess.run(
+        [sys.executable, "-m", "dst_libp2p_test_node_tpu", "run",
+         "1", "60", "500", "1", "2", "50", "50", "40", "40", "1", "0.0",
+         "4", "0", "1000", "--warmup-s", "20", "--stats-json"],
+        capture_output=True, text=True, cwd=str(tmp_path), env=env, timeout=600,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "Running for turn 1" in out.stdout
+    assert "Total Nodes :  59" in out.stdout
+    # msg_size < 1000 -> small-message summary (7 spread buckets)
+    assert (tmp_path / "latencies1").exists()
+    assert (tmp_path / "stats1.json").exists()
+    assert (tmp_path / "shadow.yaml").exists()
+    assert (tmp_path / "network_topology.gml").exists()
+
+
+def test_cli_topogen_positional_and_flag_forms(tmp_path):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH="/root/repo")
+    # the exact positional vector run.sh:49-50 passes
+    out = subprocess.run(
+        [sys.executable, "-m", "dst_libp2p_test_node_tpu", "topogen",
+         "100", "50", "150", "40", "130", "5", "0.0", "15000", "1", "10",
+         "4", "0", "4000"],
+        capture_output=True, text=True, cwd=str(tmp_path), env=env, timeout=120,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert (tmp_path / "network_topology.gml").exists()
+    out2 = subprocess.run(
+        [sys.executable, "-m", "dst_libp2p_test_node_tpu", "topogen",
+         "-n", "100", "-st", "5", "-bl", "50", "-bh", "150"],
+        capture_output=True, text=True, cwd=str(tmp_path), env=env, timeout=120,
+    )
+    assert out2.returncode == 0, out2.stderr[-2000:]
+
+
+def test_churn_configured_run():
+    cfg = small_cfg(churn_down_per_hb=0.002, churn_up_per_hb=0.001)
+    sim = Simulator(cfg)
+    recs = sim.run()
+    alive = np.asarray(sim.state.alive)
+    for r in recs:
+        # dead peers never log receipt
+        assert r.received.sum() <= 100
+    assert alive.sum() < 100  # some churn actually happened over 30+ hb
