@@ -206,6 +206,63 @@ def cmd_run(argv: list[str]) -> int:
     return 0
 
 
+def cmd_serve(argv: list[str]) -> int:
+    """Run as a long-lived node service (the reference's steady-state node:
+    HTTP /publish + /health + /ready on :8645, Prometheus on :8008), hosting
+    the whole simulated network in-process and exposing the env-selected
+    peer's view (getPeerDetails, env.nim:13-36)."""
+    p = argparse.ArgumentParser(prog="serve")
+    p.add_argument("--control-port", type=int, default=None)
+    p.add_argument("--metrics-port", type=int, default=None)
+    p.add_argument("--time-scale", type=float, default=1.0,
+                   help="simulated seconds advanced per wall second")
+    p.add_argument("--tick-s", type=float, default=1.0)
+    p.add_argument("--duration-s", type=float, default=None)
+    p.add_argument("--warmup-s", type=float, default=15.0,
+                   help="heartbeats run before serving (mesh stabilization, "
+                   "main.nim:466-477)")
+    p.add_argument("--store-metrics-dir", default=None)
+    a = p.parse_args(argv)
+
+    from .config.env import HTTP_CONTROL_PORT, PROMETHEUS_PORT, get_peer_details
+    from .runtime.node_service import serve_forever
+    from .runtime.simulator import ExperimentConfig, Simulator
+
+    node = get_peer_details()
+    node.validate()  # reject unknown muxer / connect_to >= peers at startup
+    topo = TopoParams(
+        network_size=node.network_size,
+        muxer=node.muxer,
+        num_frags=node.fragments,
+    )
+    cfg = ExperimentConfig(
+        topo=topo,
+        connect_to=node.connect_to,
+        gossipsub=node.gossipsub,
+        warmup_s=a.warmup_s,
+        self_trigger=node.self_trigger,
+        max_connections=node.max_connections,
+    )
+    sim = Simulator(cfg)
+    sim.warmup()
+    store_dir = a.store_metrics_dir
+    if store_dir is None and node.in_shadow:
+        store_dir = "."  # in-Shadow persistence default (env.nim:58-73)
+    control = a.control_port if a.control_port is not None else HTTP_CONTROL_PORT
+    metrics = a.metrics_port if a.metrics_port is not None else PROMETHEUS_PORT
+    print(
+        f"node service up: {node.network_size} peers simulated, node view "
+        f"peer {node.my_id}, control :{control} metrics :{metrics}"
+    )
+    serve_forever(
+        sim, node,
+        control_port=control, metrics_port=metrics,
+        time_scale=a.time_scale, tick_s=a.tick_s, duration_s=a.duration_s,
+        store_metrics_dir=store_dir, out=sys.stdout,
+    )
+    return 0
+
+
 def cmd_summarize(argv: list[str]) -> int:
     p = argparse.ArgumentParser(prog="summarize")
     p.add_argument("path")
@@ -237,6 +294,8 @@ def main(argv: list[str] | None = None) -> int:
         return cmd_run(rest)
     if cmd == "summarize":
         return cmd_summarize(rest)
+    if cmd == "serve":
+        return cmd_serve(rest)
     print(f"unknown command: {cmd}\n{__doc__}", file=sys.stderr)
     return 2
 

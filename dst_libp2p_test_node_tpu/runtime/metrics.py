@@ -1,0 +1,333 @@
+"""Observability layer (reference L5): Prometheus metric families.
+
+A minimal text-exposition registry (stdlib only — the reference links real
+prometheus client libraries; here /metrics is a host-side view over device
+counters, so a hand-rolled renderer keeps the node service dependency-free).
+
+Two metric families, names preserved verbatim so existing dashboards work:
+
+  - `dst_testnode_*` — the Nim flagship node's 9 custom series with
+    muxer/peer_id labels and the 12-bucket delay histogram
+    (nim-test-node/gossipsub-queues/main.nim:25-78);
+  - `libp2p_*` — the Go tracer / Rust registry family, whose names are
+    deliberately identical across languages ("Nim/go compatible metrics
+    names", rust-test-node/src/metrics.rs:12; go-test-node/metrics.go:38-287).
+
+`NodeMetrics.fill_from_sim` maps the simulator's device-side cumulative
+counters (SimState.bytes_tx/grafts/ihave_tx/... and per-message
+DisseminationResult accounting) onto these series — the TPU analog of the
+Go RawTracer observing live RPCs (metrics.go:289-464).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+
+# nim histogram buckets (main.nim:55-60)
+DELAY_BUCKETS_MS = (
+    1.0, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0,
+    1000.0, 2500.0, 5000.0, 10000.0,
+)
+
+
+def _fmt_value(v: float) -> str:
+    f = float(v)
+    if f == int(f) and abs(f) < 1e15:
+        return f"{int(f)}.0"
+    return repr(f)
+
+
+def _fmt_labels(labels: dict[str, str]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in labels.items())
+    return "{" + inner + "}"
+
+
+@dataclass
+class _Series:
+    name: str
+    help: str
+    kind: str  # counter | gauge | histogram
+    label_names: tuple[str, ...] = ()
+    values: dict[tuple[str, ...], float] = field(default_factory=dict)
+    # histogram state keyed by label values
+    hist_counts: dict[tuple[str, ...], list[int]] = field(default_factory=dict)
+    hist_sum: dict[tuple[str, ...], float] = field(default_factory=dict)
+    buckets: tuple[float, ...] = DELAY_BUCKETS_MS
+    # shared with the owning Registry: HTTP handler threads mutate series
+    # while the pump thread renders (node_service.py), so every read-modify-
+    # write and render() serializes on one lock
+    lock: threading.Lock = field(default_factory=threading.Lock)
+
+    def _key(self, labels: dict[str, str] | None) -> tuple[str, ...]:
+        labels = labels or {}
+        return tuple(str(labels.get(k, "")) for k in self.label_names)
+
+    def inc(self, amount: float = 1.0, labels: dict[str, str] | None = None):
+        k = self._key(labels)
+        with self.lock:
+            self.values[k] = self.values.get(k, 0.0) + amount
+
+    def set(self, value: float, labels: dict[str, str] | None = None):
+        k = self._key(labels)
+        with self.lock:
+            self.values[k] = float(value)
+
+    def observe(self, value: float, labels: dict[str, str] | None = None):
+        assert self.kind == "histogram"
+        k = self._key(labels)
+        with self.lock:
+            counts = self.hist_counts.setdefault(k, [0] * (len(self.buckets) + 1))
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    counts[i] += 1
+            counts[-1] += 1  # +Inf
+            self.hist_sum[k] = self.hist_sum.get(k, 0.0) + value
+
+    def get(self, labels: dict[str, str] | None = None) -> float:
+        with self.lock:
+            return self.values.get(self._key(labels), 0.0)
+
+    def render(self) -> list[str]:
+        with self.lock:
+            return self._render_locked()
+
+    def _render_locked(self) -> list[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} {self.kind}"]
+        if self.kind == "histogram":
+            keys = self.hist_counts.keys() or ([()] if not self.label_names else [])
+            for k in keys:
+                base = dict(zip(self.label_names, k))
+                counts = self.hist_counts.get(k, [0] * (len(self.buckets) + 1))
+                for i, b in enumerate(self.buckets):
+                    out.append(
+                        f"{self.name}_bucket"
+                        f"{_fmt_labels({**base, 'le': _fmt_value(b)})}"
+                        f" {counts[i]}"
+                    )
+                out.append(
+                    f'{self.name}_bucket{_fmt_labels({**base, "le": "+Inf"})} '
+                    f"{counts[-1]}"
+                )
+                out.append(
+                    f"{self.name}_sum{_fmt_labels(base)} "
+                    f"{_fmt_value(self.hist_sum.get(k, 0.0))}"
+                )
+                out.append(f"{self.name}_count{_fmt_labels(base)} {counts[-1]}")
+            return out
+        if not self.values and not self.label_names:
+            out.append(f"{self.name} 0.0")
+            return out
+        for k, v in sorted(self.values.items()):
+            out.append(
+                f"{self.name}{_fmt_labels(dict(zip(self.label_names, k)))} "
+                f"{_fmt_value(v)}"
+            )
+        return out
+
+
+class Registry:
+    def __init__(self) -> None:
+        self._series: dict[str, _Series] = {}
+        self._lock = threading.Lock()          # guards registration
+        self._data_lock = threading.Lock()     # shared by all series' data
+
+    def counter(self, name: str, help: str, labels: tuple[str, ...] = ()) -> _Series:
+        return self._add(name, help, "counter", labels)
+
+    def gauge(self, name: str, help: str, labels: tuple[str, ...] = ()) -> _Series:
+        return self._add(name, help, "gauge", labels)
+
+    def histogram(
+        self, name: str, help: str, labels: tuple[str, ...] = (),
+        buckets: tuple[float, ...] = DELAY_BUCKETS_MS,
+    ) -> _Series:
+        s = self._add(name, help, "histogram", labels)
+        s.buckets = buckets
+        return s
+
+    def _add(self, name, help, kind, labels) -> _Series:
+        with self._lock:
+            if name in self._series:
+                return self._series[name]
+            s = _Series(
+                name=name, help=help, kind=kind, label_names=tuple(labels),
+                lock=self._data_lock,
+            )
+            self._series[name] = s
+            return s
+
+    def render(self) -> str:
+        with self._lock:
+            series = list(self._series.values())
+        lines: list[str] = []
+        for s in series:
+            lines.extend(s.render())
+        return "\n".join(lines) + "\n"
+
+    def __getitem__(self, name: str) -> _Series:
+        return self._series[name]
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._series
+
+
+class NodeMetrics:
+    """The full per-node metric surface of the reference nodes."""
+
+    def __init__(self, muxer: str = "yamux", peer_id: str = "0", topic: str = "test"):
+        self.registry = Registry()
+        self.labels = {"muxer": muxer, "peer_id": peer_id}
+        self.topic = topic
+        r = self.registry
+        lab = ("muxer", "peer_id")
+
+        # --- dst_testnode_* family (main.nim:25-78) -------------------------
+        self.publish_requests = r.counter(
+            "dst_testnode_publish_requests_total",
+            "number of /publish requests accepted by the test node", lab)
+        self.publish_failures = r.counter(
+            "dst_testnode_publish_failures_total",
+            "number of failed local publish attempts", lab)
+        self.received_chunks = r.counter(
+            "dst_testnode_received_chunks_total",
+            "number of application-level message chunks received", lab)
+        self.completed_messages = r.counter(
+            "dst_testnode_completed_messages_total",
+            "number of application-level messages fully received", lab)
+        # a counter deliberately named *_sum for rate() use (main.nim:49-52;
+        # SURVEY.md §7 quirks: keep the name/semantics)
+        self.delay_sum = r.counter(
+            "dst_testnode_message_delay_ms_sum",
+            "sum of message delays in milliseconds (use with rate)", lab)
+        self.delay_hist = r.histogram(
+            "dst_testnode_message_delay_ms",
+            "message delay histogram for percentile analysis", lab)
+        self.last_delay = r.gauge(
+            "dst_testnode_last_message_delay_ms",
+            "last observed message delay in milliseconds (real-time)", lab)
+        self.mesh_size = r.gauge(
+            "dst_testnode_mesh_size",
+            "current GossipSub mesh size for the test topic", lab)
+        self.topic_peers = r.gauge(
+            "dst_testnode_topic_peers",
+            "current number of GossipSub peers for the test topic", lab)
+
+        # --- libp2p_* family (metrics.go:38-287, metrics.rs:13-200) ---------
+        self.network_bytes = r.counter(
+            "libp2p_network_bytes_total", "Total bytes sent and received",
+            ("direction",))
+        self.open_streams = r.gauge("libp2p_open_streams", "Number of open streams")
+        self.peers = r.gauge("libp2p_peers", "Number of connected peers")
+        self.pubsub_peers = r.gauge("libp2p_pubsub_peers", "Number of pubsub peers")
+        self.pubsub_topics = r.gauge(
+            "libp2p_pubsub_topics", "Number of subscribed topics")
+        self.messages_published = r.counter(
+            "libp2p_pubsub_messages_published_total",
+            "Number of messages published", ("topic",))
+        self.broadcast_messages = r.counter(
+            "libp2p_pubsub_broadcast_messages_total",
+            "Number of messages broadcast", ("topic",))
+        self.received_messages = r.counter(
+            "libp2p_pubsub_received_messages_total",
+            "Number of messages received", ("topic",))
+        for ctrl in ("subscriptions", "unsubscriptions",
+                     "ihave", "iwant", "graft", "prune", "idontwant"):
+            setattr(self, f"broadcast_{ctrl}", r.counter(
+                f"libp2p_pubsub_broadcast_{ctrl}_total",
+                f"Number of {ctrl} messages broadcast"))
+            setattr(self, f"received_{ctrl}", r.counter(
+                f"libp2p_pubsub_received_{ctrl}_total",
+                f"Number of {ctrl} messages received"))
+        self.duplicates = r.counter(
+            "libp2p_gossipsub_duplicate_total",
+            "Number of duplicate messages received")
+        self.gossipsub_received = r.counter(
+            "libp2p_gossipsub_received_total", "Number of gossipsub messages received")
+        self.mesh_per_topic = r.gauge(
+            "libp2p_gossipsub_peers_per_topic_mesh",
+            "Number of mesh peers per topic", ("topic",))
+        self.gossipsub_per_topic = r.gauge(
+            "libp2p_gossipsub_peers_per_topic_gossipsub",
+            "Number of gossipsub peers per topic", ("topic",))
+        self.no_peers_topics = r.gauge(
+            "libp2p_gossipsub_no_peers_topics", "Number of topics with no peers")
+        self.low_peers_topics = r.gauge(
+            "libp2p_gossipsub_low_peers_topics",
+            "Number of topics with fewer than d_low peers")
+        self.healthy_peers_topics = r.gauge(
+            "libp2p_gossipsub_healthy_peers_topics",
+            "Number of topics with healthy peer counts")
+        self.validation_success = r.counter(
+            "libp2p_pubsub_validation_success_total",
+            "Number of successful message validations")
+        self.validation_failure = r.counter(
+            "libp2p_pubsub_validation_failure_total",
+            "Number of failed message validations")
+        self.reject_reason = r.counter(
+            "libp2p_pubsub_reject_reason_total",
+            "Number of rejected messages by reason", ("reason",))
+        self.rpc_drop = r.counter(
+            "libp2p_pubsub_rpc_drop_total", "Number of dropped RPCs")
+
+    # ------------------------------------------------------------ observers
+
+    def on_publish_request(self, ok: bool = True) -> None:
+        self.publish_requests.inc(labels=self.labels)
+        if ok:
+            self.messages_published.inc(labels={"topic": self.topic})
+            self.broadcast_messages.inc(labels={"topic": self.topic})
+        else:
+            self.publish_failures.inc(labels=self.labels)
+
+    def on_delivery(self, delay_ms: float, chunks: int = 1) -> None:
+        """One full message delivered at this node (createMessageHandler,
+        main.nim:126-154)."""
+        self.received_chunks.inc(chunks, labels=self.labels)
+        self.completed_messages.inc(labels=self.labels)
+        self.delay_sum.inc(delay_ms, labels=self.labels)
+        self.delay_hist.observe(delay_ms, labels=self.labels)
+        self.last_delay.set(delay_ms, labels=self.labels)
+        self.received_messages.inc(labels={"topic": self.topic})
+        self.gossipsub_received.inc()
+        self.validation_success.inc()
+
+    def update_topic_health(self, mesh_count: int, d_low: int) -> None:
+        """Topic-health classifier (metrics.go:348-380, metrics.rs:158-176)."""
+        no = 1 if mesh_count == 0 else 0
+        low = 1 if 0 < mesh_count < d_low else 0
+        self.no_peers_topics.set(no)
+        self.low_peers_topics.set(low)
+        self.healthy_peers_topics.set(1 - no - low)
+
+    def fill_from_sim(self, sim, peer_id: int) -> None:
+        """Project the device-side counters into this node's series — the
+        whole-network process exposes the view of simulated peer `peer_id`."""
+        import numpy as np
+
+        st = sim.state
+        mesh_deg = int(np.asarray(st.mesh_mask[peer_id].sum()))
+        conns = int(np.asarray((sim.graph.conns[peer_id] >= 0).sum()))
+        self.mesh_size.set(mesh_deg, labels=self.labels)
+        self.topic_peers.set(conns, labels=self.labels)
+        self.peers.set(conns)
+        self.pubsub_peers.set(conns)
+        self.pubsub_topics.set(1)
+        self.open_streams.set(2 * conns)  # one stream per direction, per conn
+        self.mesh_per_topic.set(mesh_deg, labels={"topic": self.topic})
+        self.gossipsub_per_topic.set(conns, labels={"topic": self.topic})
+        self.update_topic_health(mesh_deg, sim.params.d_low)
+        self.network_bytes.set(
+            float(np.asarray(st.bytes_tx[peer_id])), labels={"direction": "out"})
+        self.network_bytes.set(
+            float(np.asarray(st.bytes_rx[peer_id])), labels={"direction": "in"})
+        self.broadcast_graft.set(float(np.asarray(st.grafts)))
+        self.received_prune.set(float(np.asarray(st.prunes)))
+        self.broadcast_ihave.set(float(np.asarray(st.ihave_tx)))
+        self.broadcast_iwant.set(float(np.asarray(st.iwant_tx)))
+        self.duplicates.set(float(np.asarray(st.dup_rx[peer_id])))
+
+    def render(self) -> str:
+        return self.registry.render()

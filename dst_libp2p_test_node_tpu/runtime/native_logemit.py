@@ -81,8 +81,6 @@ def format_block(
         )
         if written > 0:
             return buf.raw[:written].decode("ascii")
-    return "".join(
-        f"shadow.data/hosts/peer{int(pp)}/main.1000.stdout:{int(ll)}:"
-        f"{msg_id} milliseconds: {int(dd)}\n"
-        for pp, ll, dd in zip(peers, linenos, delays)
-    )
+    from .logemit import grep_lines
+
+    return "".join(line + "\n" for line in grep_lines(peers, msg_id, delays, linenos))

@@ -1,0 +1,287 @@
+"""Control & injection layer (reference L4) + live metric serving (L5).
+
+The reference exposes, per node process:
+  - HTTP POST /publish on :8645 accepting {"topic","msgSize","version"}
+    (gossipsub-queues/main.nim:192-240; go-test-node/main.go:84-151;
+    rust-test-node/src/main.rs:146-221);
+  - GET /health and /ready returning "ok" (kad-dht/helpers.nim:94-117,
+    service-discovery/helpers.nim:138-161);
+  - Prometheus GET /metrics on :8008 (env.nim:39-55);
+  - in-Shadow metric persistence: append the node's own /metrics scrape to
+    metrics_pod-<id>.txt every 5 min, start staggered by myId*60 ms
+    (env.nim:58-73, env.go:118-146, env.rs:114-152).
+
+TPU-native shape: one process hosts the WHOLE simulated network, so the
+service wraps a Simulator. /publish lands mid-simulation and is buffered
+into a queue the simulation loop drains at round granularity — faithful to
+the reference, whose injector itself quantizes at inter_message_delay
+granularity (shadow/topogen.py:129; SURVEY.md §7 "host/device control
+plane"). HTTP handler threads never touch JAX: they enqueue requests and
+read a metrics snapshot the pump loop refreshes under a lock.
+
+The Rust node routes /publish through an mpsc channel into its single swarm
+event loop (main.rs:466-516) — the same design, channel = PublishQueue.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+import time
+from dataclasses import dataclass
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..config.env import HTTP_CONTROL_PORT, PROMETHEUS_PORT, NodeConfig
+from .metrics import NodeMetrics
+
+
+@dataclass
+class PublishRequest:
+    topic: str
+    msg_size: int
+    version: int = 1
+
+
+class PublishQueue:
+    """Thread-safe publish buffer between HTTP handlers and the sim loop."""
+
+    def __init__(self) -> None:
+        self._q: queue.Queue[PublishRequest] = queue.Queue()
+
+    def put(self, req: PublishRequest) -> None:
+        self._q.put(req)
+
+    def drain(self) -> list[PublishRequest]:
+        out = []
+        while True:
+            try:
+                out.append(self._q.get_nowait())
+            except queue.Empty:
+                return out
+
+
+def _json_response(handler, code: int, payload: dict) -> None:
+    body = json.dumps(payload).encode()
+    handler.send_response(code)
+    handler.send_header("Content-Type", "application/json")
+    handler.send_header("Content-Length", str(len(body)))
+    handler.end_headers()
+    handler.wfile.write(body)
+
+
+def _text_response(handler, code: int, text: str, ctype="text/plain") -> None:
+    body = text.encode()
+    handler.send_response(code)
+    handler.send_header("Content-Type", ctype)
+    handler.send_header("Content-Length", str(len(body)))
+    handler.end_headers()
+    handler.wfile.write(body)
+
+
+class NodeService:
+    """Host-side control plane over the device-side simulation."""
+
+    def __init__(
+        self,
+        simulator,
+        cfg: NodeConfig | None = None,
+        control_port: int = HTTP_CONTROL_PORT,
+        metrics_port: int = PROMETHEUS_PORT,
+    ) -> None:
+        self.sim = simulator
+        self.cfg = cfg or NodeConfig()
+        self.topic = self.cfg.topic
+        self.publishes = PublishQueue()
+        self.metrics = NodeMetrics(
+            muxer=self.cfg.muxer, peer_id=str(self.cfg.my_id), topic=self.topic)
+        self._metrics_text = self.metrics.render()
+        self._lock = threading.Lock()
+        self._control_port = control_port
+        self._metrics_port = metrics_port
+        self._servers: list[ThreadingHTTPServer] = []
+        self._threads: list[threading.Thread] = []
+        self._stop = threading.Event()
+        self.lines_out: list[str] = []  # latency lines emitted by pump()
+
+    # ------------------------------------------------------------- servers
+
+    @property
+    def control_port(self) -> int:
+        return self._control_port
+
+    @property
+    def metrics_port(self) -> int:
+        return self._metrics_port
+
+    def start(self) -> None:
+        svc = self
+
+        class ControlHandler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def do_GET(self):
+                if self.path in ("/health", "/ready"):
+                    _text_response(self, 200, "ok")
+                else:
+                    _text_response(self, 404, "Not Found")
+
+            def do_POST(self):
+                if self.path != "/publish":
+                    _text_response(self, 404, "Not Found")
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    body = json.loads(self.rfile.read(n))
+                    req = PublishRequest(
+                        topic=body["topic"],
+                        msg_size=int(body["msgSize"]),
+                        version=int(body.get("version", 1)),
+                    )
+                except Exception as e:  # malformed request -> 400 (main.nim:227-230)
+                    _json_response(
+                        self, 400, {"status": "error", "message": str(e)})
+                    return
+                if req.topic != svc.topic:
+                    # "Topic not joined" (main.go:107-110)
+                    _text_response(self, 500, "Topic not joined")
+                    return
+                t_pub = svc.enqueue_publish(req)
+                _json_response(self, 200, {
+                    "status": "success",
+                    "message": f"Message published at time {t_pub}",
+                })
+
+            def do_PUT(self):
+                _text_response(self, 405, "Method Not Supported")
+
+        class MetricsHandler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                if self.path == "/metrics":
+                    _text_response(
+                        self, 200, svc.metrics_text(),
+                        ctype="text/plain; version=0.0.4")
+                else:
+                    _text_response(self, 404, "Not Found")
+
+        for port_attr, handler in (
+            ("_control_port", ControlHandler), ("_metrics_port", MetricsHandler)
+        ):
+            server = ThreadingHTTPServer(("0.0.0.0", getattr(self, port_attr)), handler)
+            setattr(self, port_attr, server.server_address[1])  # resolve port 0
+            t = threading.Thread(target=server.serve_forever, daemon=True)
+            t.start()
+            self._servers.append(server)
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        for s in self._servers:
+            s.shutdown()
+            s.server_close()
+        self._servers.clear()
+
+    # --------------------------------------------------------------- plumbing
+
+    def enqueue_publish(self, req: PublishRequest) -> int:
+        """Accept a /publish; returns the quantized injection time (ns scale
+        matches the reference's 'published at time <ns>' reply)."""
+        self.publishes.put(req)
+        self.metrics.on_publish_request(ok=True)
+        t_ms = float(self.sim.state.t_ms)
+        return int(t_ms * 1e6)  # ns
+
+    def metrics_text(self) -> str:
+        with self._lock:
+            return self._metrics_text
+
+    def pump(self, advance_ms: float = 0.0) -> int:
+        """One service round: advance sim time, drain queued publishes, emit
+        latency lines, refresh the metrics snapshot. Returns #published."""
+        if advance_ms > 0:
+            self.sim.advance(advance_ms)
+        n_pub = 0
+        view = self.cfg.my_id % self.sim.params.n  # the simulated peer this
+        # node's metrics report for (my_id can exceed n via PEER_ID_OFFSET)
+        for req in self.publishes.drain():
+            rec = self.sim.publish(view, msg_size=req.msg_size)
+            n_pub += 1
+            # the stdout contract (main.nim:150): one line per receiver
+            for peer, d in zip(rec.receivers, rec.delays_ms_int):
+                self.lines_out.append(f"{rec.msg_id} milliseconds: {d}")
+                if peer == view:
+                    self.metrics.on_delivery(float(d), chunks=self.sim.cfg.topo.num_frags)
+        self.metrics.fill_from_sim(self.sim, view)
+        with self._lock:
+            self._metrics_text = self.metrics.render()
+        return n_pub
+
+    # ----------------------------------------------------- metric persistence
+
+    def store_metrics_loop(
+        self, out_dir: str = ".", interval_s: float = 300.0,
+        stagger: bool = True, max_iters: int | None = None,
+    ) -> threading.Thread:
+        """Background metrics_pod-<id>.txt appender (env.nim:58-73). Like the
+        Rust node we snapshot the registry directly instead of scraping
+        localhost (env.rs:114-152 — the Shadow-friendly variant)."""
+        my_id = self.cfg.my_id
+
+        def loop():
+            time.sleep(my_id * 0.060 if stagger else 0.0)  # myId*60ms stagger
+            i = 0
+            while not self._stop.is_set():
+                with open(f"{out_dir}/metrics_pod-{my_id}.txt", "a") as f:
+                    f.write(self.metrics_text())
+                i += 1
+                if max_iters is not None and i >= max_iters:
+                    return
+                if self._stop.wait(interval_s):
+                    return
+
+        t = threading.Thread(target=loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+        return t
+
+
+def serve_forever(
+    simulator, cfg: NodeConfig, *,
+    control_port: int = HTTP_CONTROL_PORT,
+    metrics_port: int = PROMETHEUS_PORT,
+    time_scale: float = 1.0,
+    tick_s: float = 1.0,
+    duration_s: float | None = None,
+    store_metrics_dir: str | None = None,
+    out=None,
+) -> NodeService:
+    """Run the node service loop: each wall tick advances the simulation by
+    tick_s * time_scale seconds of simulated time and drains the publish
+    queue. `duration_s` bounds the loop (None = until KeyboardInterrupt)."""
+    svc = NodeService(
+        simulator, cfg, control_port=control_port, metrics_port=metrics_port)
+    svc.start()
+    if store_metrics_dir is not None:
+        svc.store_metrics_loop(store_metrics_dir)
+    t_end = None if duration_s is None else time.monotonic() + duration_s
+    try:
+        while t_end is None or time.monotonic() < t_end:
+            t0 = time.monotonic()
+            svc.pump(advance_ms=tick_s * time_scale * 1000.0)
+            if out is not None:
+                for line in svc.lines_out:
+                    print(line, file=out)
+            svc.lines_out.clear()  # always drain — a long-lived service must
+            # not accumulate one string per receiver per message forever
+            leftover = tick_s - (time.monotonic() - t0)
+            if leftover > 0 and svc._stop.wait(leftover):
+                break
+    except KeyboardInterrupt:
+        pass
+    finally:
+        svc.stop()
+    return svc

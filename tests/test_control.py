@@ -1,0 +1,155 @@
+"""Control & observability surface tests (reference L4/L5 parity).
+
+Covers: the /publish JSON contract (gossipsub-queues/main.nim:192-240,
+go-test-node/main.go:84-151), /health /ready (kad-dht/helpers.nim:94-117),
+Prometheus exposition with the reference's metric names
+(main.nim:25-78, metrics.go:38-287, metrics.rs:13-200), and the
+metrics_pod-<id>.txt persistence loop (env.nim:58-73)."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from dst_libp2p_test_node_tpu.config.env import NodeConfig
+from dst_libp2p_test_node_tpu.config.topology import TopoParams
+from dst_libp2p_test_node_tpu.runtime.metrics import NodeMetrics
+from dst_libp2p_test_node_tpu.runtime.node_service import NodeService
+from dst_libp2p_test_node_tpu.runtime.simulator import ExperimentConfig, Simulator
+
+
+def _post(url, payload):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return r.status, json.loads(r.read())
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return r.status, r.read().decode()
+
+
+@pytest.fixture(scope="module")
+def service():
+    cfg = ExperimentConfig(
+        topo=TopoParams(network_size=16, msg_size_bytes=500, messages=1),
+        connect_to=4, warmup_s=5.0, seed=3,
+    )
+    sim = Simulator(cfg)
+    sim.warmup()
+    node = NodeConfig(my_id=2, network_size=16, connect_to=4)
+    svc = NodeService(sim, node, control_port=0, metrics_port=0)
+    svc.start()
+    yield svc
+    svc.stop()
+
+
+class TestControlEndpoints:
+    def test_health_and_ready(self, service):
+        for path in ("/health", "/ready"):
+            status, body = _get(f"http://127.0.0.1:{service.control_port}{path}")
+            assert status == 200
+            assert body == "ok"
+
+    def test_unknown_path_404(self, service):
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(f"http://127.0.0.1:{service.control_port}/nope")
+        assert e.value.code == 404
+
+    def test_publish_contract(self, service):
+        status, body = _post(
+            f"http://127.0.0.1:{service.control_port}/publish",
+            {"topic": "test", "msgSize": 500, "version": 1},
+        )
+        assert status == 200
+        assert body["status"] == "success"
+        assert body["message"].startswith("Message published at time ")
+        # the request is queued until the sim loop pumps
+        assert service.pump() == 1
+        assert len(service.lines_out) > 0
+        msg_id, kw, delay = service.lines_out[0].split()
+        assert kw == "milliseconds:"
+        assert int(delay) >= 0
+
+    def test_publish_unjoined_topic_500(self, service):
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(
+                f"http://127.0.0.1:{service.control_port}/publish",
+                {"topic": "other", "msgSize": 100},
+            )
+        assert e.value.code == 500
+        assert e.value.read().decode() == "Topic not joined"
+
+    def test_publish_malformed_400(self, service):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{service.control_port}/publish",
+            data=b"not json", method="POST")
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=10)
+        assert e.value.code == 400
+
+    def test_metrics_endpoint(self, service):
+        service.pump()
+        status, text = _get(f"http://127.0.0.1:{service.metrics_port}/metrics")
+        assert status == 200
+        for name in (
+            "dst_testnode_publish_requests_total",
+            "dst_testnode_completed_messages_total",
+            "dst_testnode_message_delay_ms_bucket",
+            "dst_testnode_mesh_size",
+            "libp2p_gossipsub_peers_per_topic_mesh",
+            "libp2p_pubsub_messages_published_total",
+        ):
+            assert name in text, f"missing metric {name}"
+        # node-view labels present (muxer/peer_id, main.nim:20-23)
+        assert 'muxer="yamux"' in text and 'peer_id="2"' in text
+
+    def test_mesh_size_reflects_sim(self, service):
+        service.pump()
+        import numpy as np
+        deg = int(np.asarray(service.sim.state.mesh_mask[2].sum()))
+        assert service.metrics.mesh_size.get(service.metrics.labels) == deg
+        assert deg >= 1  # warm mesh
+
+    def test_store_metrics_file(self, service, tmp_path):
+        service.pump()
+        t = service.store_metrics_loop(
+            out_dir=str(tmp_path), interval_s=0.01, stagger=False, max_iters=2)
+        t.join(timeout=10)
+        content = (tmp_path / "metrics_pod-2.txt").read_text()
+        # two appended scrapes (5-minute loop in production)
+        assert content.count("# TYPE dst_testnode_mesh_size gauge") == 2
+
+
+class TestNodeMetrics:
+    def test_histogram_buckets_match_reference(self):
+        m = NodeMetrics()
+        m.on_delivery(30.0)
+        m.on_delivery(700.0)
+        text = m.render()
+        # nim buckets (main.nim:55-60): 30ms lands in le=50, 700ms in le=1000
+        assert 'dst_testnode_message_delay_ms_bucket{muxer="yamux",peer_id="0",le="50.0"} 1' in text
+        assert 'dst_testnode_message_delay_ms_bucket{muxer="yamux",peer_id="0",le="1000.0"} 2' in text
+        assert 'le="+Inf"} 2' in text
+        # the separate rate()-style counter (SURVEY.md §7 quirks)
+        assert m.delay_sum.get(m.labels) == 730.0
+
+    def test_topic_health_classifier(self):
+        # metrics.rs:158-176: 0 -> no_peers, <d_low -> low, else healthy
+        m = NodeMetrics()
+        m.update_topic_health(0, d_low=4)
+        assert m.no_peers_topics.get() == 1
+        m.update_topic_health(2, d_low=4)
+        assert m.low_peers_topics.get() == 1
+        assert m.no_peers_topics.get() == 0
+        m.update_topic_health(6, d_low=4)
+        assert m.healthy_peers_topics.get() == 1
+
+    def test_publish_failure_counted(self):
+        m = NodeMetrics()
+        m.on_publish_request(ok=False)
+        assert m.publish_failures.get(m.labels) == 1
+        assert m.publish_requests.get(m.labels) == 1
