@@ -1,6 +1,6 @@
 """CLI driver: the `SIMBACKEND=tpu` replacement for shadow/run.sh + topogen.py.
 
-Three subcommands:
+Subcommands:
 
   topogen    — emit network_topology.gml + shadow.yaml. Accepts BOTH the
                reference topogen's argparse flags (-n/-bl/-bh/...) and the 13
@@ -13,6 +13,11 @@ Three subcommands:
                and prints the per-run summaries (small/large switch at
                msg_size < 1000, run.sh:68-72).
   summarize  — re-run the summary over an existing latencies file.
+  serve      — long-lived node service (HTTP /publish + /health, Prometheus).
+  kad        — role-based kad-dht workload (bootstrap/normal/probe).
+  connmanager — hub-and-spoke watermark/reconnect stress workload.
+  servicedisco — advertise/lookup service discovery over the DHT.
+  regression — GossipSub-over-kad-dht discovery workload with mesh pings.
 
 Usage:
   python -m dst_libp2p_test_node_tpu run 1 1000 15000 1 10 50 150 40 130 5 0.0 4 0 4000
@@ -263,6 +268,150 @@ def cmd_serve(argv: list[str]) -> int:
     return 0
 
 
+def cmd_kad(argv: list[str]) -> int:
+    """Role-based kad-dht workload (kad-dht/main.nim:15-72): bootstrap
+    anchors + RoleNormal warmup + RoleProbe lookup loop, batched."""
+    p = argparse.ArgumentParser(prog="kad")
+    p.add_argument("-n", "--nodes", type=int, default=None,
+                   help="defaults to PEERS env")
+    p.add_argument("--bootstraps", type=int, default=None)
+    p.add_argument("--probes", type=int, default=None)
+    p.add_argument("--discovery", choices=["kad-dht", "extended"], default=None)
+    p.add_argument("--duration-s", type=float, default=60.0)
+    p.add_argument("--seed", type=int, default=None)
+    p.add_argument("--log", default=None, help="write node log lines here")
+    a = p.parse_args(argv)
+
+    from .runtime.kad_runtime import KadSimulator, config_from_env
+
+    cfg = config_from_env()
+    if a.nodes is not None:
+        cfg.network_size = a.nodes
+    if a.bootstraps is not None:
+        cfg.n_bootstrap = a.bootstraps
+    if a.probes is not None:
+        cfg.n_probe = a.probes
+    if a.discovery is not None:
+        cfg.discovery = a.discovery
+    if a.seed is not None:
+        cfg.seed = a.seed
+    cfg.probe_duration_s = a.duration_s
+    cfg.validate()
+    t0 = time.time()
+    sim = KadSimulator(cfg)
+    summary = sim.run()
+    wall = time.time() - t0
+    if a.log:
+        with open(a.log, "w") as f:
+            f.write("\n".join(sim.lines) + "\n")
+    print(summary.report())
+    print(f"[tpu backend] wall={wall:.2f}s lookups={len(sim.lookups)}")
+    return 0
+
+
+def cmd_connmanager(argv: list[str]) -> int:
+    """Hub-and-spoke connection-manager stress (connmanager/main.nim):
+    watermark trimming + reconnect strategies, driven by the WATERMARK_*/
+    RECONNECT env surface with flag overrides."""
+    p = argparse.ArgumentParser(prog="connmanager")
+    p.add_argument("--duration-s", type=int, default=None)
+    p.add_argument("--trace", default=None,
+                   help="write the per-tick hub connection counts (CSV)")
+    a = p.parse_args(argv)
+
+    from .ops.connmanager import config_from_env, run_connmanager
+
+    cfg = config_from_env()
+    if a.duration_s is not None:
+        cfg.duration_s = a.duration_s
+    t0 = time.time()
+    summary, _ = run_connmanager(cfg)
+    wall = time.time() - t0
+    if a.trace:
+        import numpy as np
+
+        np.savetxt(a.trace, summary.trace, fmt="%d", delimiter=",")
+    print(summary.report())
+    print(f"[tpu backend] wall={wall:.2f}s ticks={len(summary.trace)}")
+    return 0
+
+
+def cmd_regression(argv: list[str]) -> int:
+    """Regression workload (regression/main.nim): GossipSub mesh formed via
+    kad-dht bootstrap + mesh ping probes + standard latency output."""
+    p = argparse.ArgumentParser(prog="regression")
+    p.add_argument("-n", "--nodes", type=int, default=None)
+    p.add_argument("--messages", type=int, default=None)
+    p.add_argument("--msg-size", type=int, default=None)
+    p.add_argument("--log", default=None)
+    p.add_argument("--latencies", default=None,
+                   help="write awk-compatible latencies file here")
+    a = p.parse_args(argv)
+
+    from .runtime.logemit import LatenciesWriter
+    from .runtime.regression_runtime import (
+        RegressionSimulator,
+        config_from_env as regression_config,
+    )
+
+    cfg = regression_config()
+    if a.nodes is not None:
+        cfg.network_size = a.nodes
+    if a.messages is not None:
+        cfg.messages = a.messages
+    if a.msg_size is not None:
+        cfg.msg_size = a.msg_size
+    cfg.validate()
+    t0 = time.time()
+    sim = RegressionSimulator(cfg)
+    summary = sim.run()
+    wall = time.time() - t0
+    if a.log:
+        with open(a.log, "w") as f:
+            f.write("\n".join(sim.lines) + "\n")
+    if a.latencies:
+        w = LatenciesWriter()
+        for rec in sim.records():
+            w.add_message(rec.msg_id, rec.receivers, rec.delays_ms_int)
+        w.write(a.latencies)
+    print(summary.report())
+    print(f"[tpu backend] wall={wall:.2f}s")
+    return 0
+
+
+def cmd_servicedisco(argv: list[str]) -> int:
+    """Service-discovery workload (service-discovery/main.nim): advertisers
+    + discoverers + hybrid over the DHT, env-driven with flag overrides."""
+    p = argparse.ArgumentParser(prog="servicedisco")
+    p.add_argument("-n", "--nodes", type=int, default=None)
+    p.add_argument("--duration-s", type=int, default=None)
+    p.add_argument("--services", default=None,
+                   help="comma-separated (ADVERTISE_SERVICES)")
+    p.add_argument("--log", default=None)
+    a = p.parse_args(argv)
+
+    from .runtime.sd_runtime import SDSimulator, config_from_env
+
+    cfg = config_from_env()
+    if a.nodes is not None:
+        cfg.network_size = a.nodes
+    if a.duration_s is not None:
+        cfg.duration_s = a.duration_s
+    if a.services:
+        cfg.services = [s.strip() for s in a.services.split(",") if s.strip()]
+    cfg.validate()
+    t0 = time.time()
+    sim = SDSimulator(cfg)
+    summary = sim.run()
+    wall = time.time() - t0
+    if a.log:
+        with open(a.log, "w") as f:
+            f.write("\n".join(sim.lines) + "\n")
+    print(summary.report())
+    print(f"[tpu backend] wall={wall:.2f}s")
+    return 0
+
+
 def cmd_summarize(argv: list[str]) -> int:
     p = argparse.ArgumentParser(prog="summarize")
     p.add_argument("path")
@@ -296,6 +445,14 @@ def main(argv: list[str] | None = None) -> int:
         return cmd_summarize(rest)
     if cmd == "serve":
         return cmd_serve(rest)
+    if cmd == "kad":
+        return cmd_kad(rest)
+    if cmd == "connmanager":
+        return cmd_connmanager(rest)
+    if cmd == "servicedisco":
+        return cmd_servicedisco(rest)
+    if cmd == "regression":
+        return cmd_regression(rest)
     print(f"unknown command: {cmd}\n{__doc__}", file=sys.stderr)
     return 2
 

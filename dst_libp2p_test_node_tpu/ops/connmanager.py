@@ -1,0 +1,310 @@
+"""Connection-manager stress workload: hub watermark dynamics as a jit scan.
+
+The reference connmanager node (nim-test-node/connmanager/{main,env}.nim)
+stresses nim-libp2p's ConnManager (7cc4280e connmanager-logging branch): a
+hub with `withWatermark(lowWater, highWater, gracePeriod, silencePeriod)`
+trimming and an optional hard cap (maxConnections, main.nim:54-55), protected
+peers (connManager.protect, main.nim:59-60), hub-to-hub full mesh
+(main.nim:80-91), and spoke peers with three reconnect strategies
+(main.nim:115-139):
+
+  ReconnectNone        dial each hub once, then idle
+  ReconnectAggressive  every 1 s: if outbound conns < |hubs|, redial all hubs
+  ReconnectBeforeGrace dial, wait reconnectInterval, disconnect all, repeat —
+                       deliberately staying inside every hub's grace window
+                       ("Cycled connection (grace abuse)", main.nim:132)
+
+TPU-native design: connection state is an (H, M) edge matrix (hubs x peers)
+of booleans + connect timestamps; one `lax.scan` step = one second. Each step
+applies, in order: peer dial decisions (per-role masks), the hard cap
+(capacity-ranked accept), and — on silence-period ticks — watermark trimming:
+if a hub's count exceeds highWater, disconnect down to lowWater, sparing
+protected peers and connections younger than gracePeriod, evicting the
+OLDEST eligible connections first (the manager trims long-lived excess while
+the grace window shields fresh dials — the behavior the grace-abuse strategy
+exploits). The scan emits a per-tick connection-count trace, the workload's
+primary measured output.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+RECONNECT_NONE = 0
+RECONNECT_AGGRESSIVE = 1
+RECONNECT_BEFORE_GRACE = 2
+
+BIG = jnp.float32(1e30)
+
+
+@dataclass(frozen=True)
+class ConnManagerParams:
+    """Static workload parameters (hub + peer env surface, env.nim:14-105)."""
+
+    n_hubs: int = 1               # NUM_HUBS
+    n_peers: int = 40
+    low_water: int = 10           # WATERMARK_LOW
+    high_water: int = 20          # WATERMARK_HIGH
+    grace_period_s: int = 0       # WATERMARK_GRACE_PERIOD_S
+    silence_period_s: int = 2     # WATERMARK_SILENCE_PERIOD_S
+    max_connections: int = 0      # MAX_CONNECTIONS; 0 = no hard cap
+    reconnect_interval_s: int = 55  # RECONNECT_INTERVAL_S
+
+    def validate(self) -> None:
+        if not (0 < self.low_water <= self.high_water):
+            raise ValueError("require 0 < low_water <= high_water")
+        if self.silence_period_s < 1:
+            raise ValueError("silence_period_s must be >= 1")
+        if self.n_hubs < 1 or self.n_peers < 1:
+            raise ValueError("need at least one hub and one peer")
+
+
+@struct.dataclass
+class ConnState:
+    """Device-side hub-spoke connection state."""
+
+    conn: jnp.ndarray          # (H, M) bool — peer-to-hub connection up
+    since_ms: jnp.ndarray      # (H, M) float32 — connect timestamp
+    hub_conn: jnp.ndarray      # (H, H) bool — hub-to-hub mesh
+    t_ms: jnp.ndarray          # () float32
+    key: jnp.ndarray
+    # counters (the connmanager-logging branch's log-derived measurables)
+    dials: jnp.ndarray         # () int32 successful connects
+    rejected: jnp.ndarray      # () int32 dials refused by the hard cap
+    trims: jnp.ndarray         # () int32 watermark disconnects
+    cycles: jnp.ndarray        # () int32 grace-abuse cycle disconnects
+
+
+def init_conn_state(params: ConnManagerParams, seed: int = 0) -> ConnState:
+    h, m = params.n_hubs, params.n_peers
+    return ConnState(
+        conn=jnp.zeros((h, m), bool),
+        since_ms=jnp.zeros((h, m), jnp.float32),
+        hub_conn=(~jnp.eye(h, dtype=bool)) if h > 1 else jnp.zeros((h, h), bool),
+        t_ms=jnp.asarray(0.0, jnp.float32),
+        key=jax.random.PRNGKey(seed),
+        dials=jnp.asarray(0, jnp.int32),
+        rejected=jnp.asarray(0, jnp.int32),
+        trims=jnp.asarray(0, jnp.int32),
+        cycles=jnp.asarray(0, jnp.int32),
+    )
+
+
+def _ranks(priority: jnp.ndarray) -> jnp.ndarray:
+    return jnp.argsort(jnp.argsort(priority, axis=-1), axis=-1)
+
+
+@partial(jax.jit, static_argnames=("params",))
+def conn_step(
+    state: ConnState,
+    reconnect_mode: jnp.ndarray,   # (M,) int32 per-peer strategy
+    dial_out: jnp.ndarray,         # (M,) bool — DIAL_OUT
+    protected: jnp.ndarray,        # (M,) bool — PROTECTED_PEERS
+    params: ConnManagerParams,
+) -> ConnState:
+    """One 1-second tick of the hub/peer programs."""
+    h, m = state.conn.shape
+    t = state.t_ms + 1000.0
+    key, k_dial = jax.random.split(state.key)
+    conn, since = state.conn, state.since_ms
+    cycles = state.cycles
+
+    # -- peer programs (main.nim:115-139) ------------------------------------
+    # before_grace: on each reconnectInterval boundary, drop everything...
+    tick = jnp.int32(t / 1000.0)
+    cycle_now = (tick % params.reconnect_interval_s == 0) & (
+        reconnect_mode == RECONNECT_BEFORE_GRACE
+    )
+    dropped = conn & cycle_now[None, :]
+    cycles = cycles + dropped.sum(dtype=jnp.int32)
+    conn = conn & ~cycle_now[None, :]
+
+    # dial decisions: aggressive redials every tick while any hub is missing;
+    # none/before_grace dial whenever currently unconnected (none only ever
+    # fires at t=0 or after a trim with no retry budget left -> model the
+    # 10-attempt backoff envelope as one-shot: dial only if never connected)
+    missing = ~conn                               # (H, M)
+    aggressive = (reconnect_mode == RECONNECT_AGGRESSIVE) & (
+        conn.sum(axis=0) < h
+    )
+    first_dial = (since.max(axis=0) == 0.0) & ~conn.any(axis=0)
+    cycler = reconnect_mode == RECONNECT_BEFORE_GRACE
+    wants = dial_out & (aggressive | first_dial | (cycler & cycle_now))
+    dialing = missing & wants[None, :]
+
+    # -- hard cap (MAX_CONNECTIONS semaphore, main.nim:54-55) ----------------
+    if params.max_connections > 0:
+        room = params.max_connections - conn.sum(axis=-1)
+        order = _ranks(jnp.where(dialing, jax.random.uniform(k_dial, (h, m)), BIG))
+        accepted = dialing & (order < room[:, None])
+        rejected = (dialing & ~accepted).sum(dtype=jnp.int32)
+    else:
+        accepted = dialing
+        rejected = jnp.int32(0)
+
+    since = jnp.where(accepted & ~conn, t, since)
+    conn = conn | accepted
+    dials = state.dials + accepted.sum(dtype=jnp.int32)
+
+    # -- hub watermark trim, every silencePeriod ticks -----------------------
+    trim_now = tick % params.silence_period_s == 0
+    count = conn.sum(axis=-1)                     # (H,)
+    over = (count > params.high_water) & trim_now
+    excess = jnp.where(over, count - params.low_water, 0)
+    age_ms = t - since
+    in_grace = age_ms < params.grace_period_s * 1000.0
+    evictable = conn & ~protected[None, :] & ~in_grace
+    # oldest eligible first: rank by descending age
+    prio = jnp.where(evictable, -age_ms, BIG)
+    evict = (_ranks(prio) < excess[:, None]) & evictable
+    trims = state.trims + evict.sum(dtype=jnp.int32)
+    conn = conn & ~evict
+
+    return state.replace(
+        conn=conn,
+        since_ms=since,
+        t_ms=t,
+        key=key,
+        dials=dials,
+        rejected=state.rejected + rejected,
+        trims=trims,
+        cycles=cycles,
+    )
+
+
+@partial(jax.jit, static_argnames=("params", "steps"))
+def run_conn_steps(
+    state: ConnState,
+    reconnect_mode: jnp.ndarray,
+    dial_out: jnp.ndarray,
+    protected: jnp.ndarray,
+    params: ConnManagerParams,
+    steps: int,
+):
+    """Scan `steps` seconds; returns (state, per-tick hub conn counts (T, H))
+    — the connection-count time series the reference reads off its metrics."""
+
+    def body(s, _):
+        s = conn_step(s, reconnect_mode, dial_out, protected, params)
+        # a hub's connection count includes its hub-to-hub mesh edges
+        # (main.nim:80-91 dials every other hub replica); the mesh is
+        # infrastructure the hubs keep alive, so it rides outside the
+        # spoke-trim dynamics but inside the reported count
+        total = (s.conn.sum(axis=-1) + s.hub_conn.sum(axis=-1))
+        return s, total.astype(jnp.int32)
+
+    return jax.lax.scan(body, state, None, length=steps)
+
+
+# ---------------------------------------------------------------- experiment
+
+
+@dataclass
+class ConnManagerConfig:
+    """Whole-experiment shape: the reference deploys role-per-pod via
+    NODE_ROLE/RECONNECT env (env.nim:39-105); here the simulator owns all
+    roles, with peer counts per strategy."""
+
+    params: ConnManagerParams = field(default_factory=ConnManagerParams)
+    n_none: int = 20
+    n_aggressive: int = 10
+    n_before_grace: int = 10
+    n_protected: int = 0          # first peers of the none-group, protected
+    duration_s: int = 120
+    seed: int = 0
+
+    def roles(self) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        m = self.params.n_peers
+        assert self.n_none + self.n_aggressive + self.n_before_grace == m
+        mode = np.concatenate([
+            np.full(self.n_none, RECONNECT_NONE),
+            np.full(self.n_aggressive, RECONNECT_AGGRESSIVE),
+            np.full(self.n_before_grace, RECONNECT_BEFORE_GRACE),
+        ]).astype(np.int32)
+        dial_out = np.ones(m, bool)
+        protected = np.zeros(m, bool)
+        protected[: self.n_protected] = True
+        return mode, dial_out, protected
+
+
+@dataclass
+class ConnManagerSummary:
+    mean_conns: float
+    max_conns: int
+    min_conns_after_warm: int
+    dials: int
+    rejected: int
+    trims: int
+    cycles: int
+    trace: np.ndarray            # (T, H) per-tick counts
+
+    def report(self) -> str:
+        return "\n".join([
+            "ConnManager summary",
+            f"Hub connections: mean {self.mean_conns:.1f} max {self.max_conns} "
+            f"min-after-warmup {self.min_conns_after_warm}",
+            f"Dials accepted: {self.dials}  rejected by cap: {self.rejected}",
+            f"Watermark trims: {self.trims}",
+            f"Grace-abuse cycles: {self.cycles}",
+        ])
+
+
+def run_connmanager(cfg: ConnManagerConfig) -> tuple[ConnManagerSummary, ConnState]:
+    cfg.params.validate()
+    if cfg.duration_s < 1:
+        raise ValueError("duration_s must be >= 1")
+    mode, dial_out, protected = cfg.roles()
+    state = init_conn_state(cfg.params, seed=cfg.seed)
+    state, trace = run_conn_steps(
+        state, jnp.asarray(mode), jnp.asarray(dial_out), jnp.asarray(protected),
+        cfg.params, cfg.duration_s,
+    )
+    tr = np.asarray(trace)
+    warm = min(5, len(tr) - 1)
+    summary = ConnManagerSummary(
+        mean_conns=float(tr.mean()),
+        max_conns=int(tr.max()),
+        min_conns_after_warm=int(tr[warm:].min()),
+        dials=int(state.dials),
+        rejected=int(state.rejected),
+        trims=int(state.trims),
+        cycles=int(state.cycles),
+        trace=tr,
+    )
+    return summary, state
+
+
+def config_from_env() -> ConnManagerConfig:
+    """WATERMARK_*/MAX_CONNECTIONS/RECONNECT* env surface (env.nim:39-105)."""
+    from ..config.env import env_int, env_str
+
+    n_none = env_int("CONNMGR_PEERS_NONE", 20)
+    n_agg = env_int("CONNMGR_PEERS_AGGRESSIVE", 10)
+    n_bg = env_int("CONNMGR_PEERS_BEFORE_GRACE", 10)
+    params = ConnManagerParams(
+        n_hubs=env_int("NUM_HUBS", 1),
+        n_peers=n_none + n_agg + n_bg,
+        low_water=env_int("WATERMARK_LOW", 10),
+        high_water=env_int("WATERMARK_HIGH", 20),
+        grace_period_s=env_int("WATERMARK_GRACE_PERIOD_S", 0),
+        silence_period_s=env_int("WATERMARK_SILENCE_PERIOD_S", 2),
+        max_connections=env_int("MAX_CONNECTIONS", 0),
+        reconnect_interval_s=env_int("RECONNECT_INTERVAL_S", 55),
+    )
+    n_protected = len([s for s in env_str("PROTECTED_PEERS", "").split(",")
+                       if s.strip()])
+    return ConnManagerConfig(
+        params=params,
+        n_none=n_none,
+        n_aggressive=n_agg,
+        n_before_grace=n_bg,
+        n_protected=n_protected,
+        duration_s=env_int("CONNMGR_DURATION_S", 120),
+        seed=env_int("SEED", 0),
+    )

@@ -1,0 +1,385 @@
+"""Kademlia DHT substrate: XOR-metric routing tables and FIND_NODE lookups
+as fixed-shape batched array ops.
+
+The reference's kad-dht node (nim-test-node/kad-dht/{main,core,helpers}.nim)
+delegates the protocol to nim-libp2p's KadDHT: a per-node routing table of
+XOR-distance buckets, iterative FIND_NODE lookups (query the alpha closest
+known peers, merge their k closest entries, repeat), and three roles —
+RoleBootstrap (passive anchor), RoleNormal (warmup: 5x FIND_NODE(self) +
+15x FIND_NODE(random), kad-dht/core.nim:12-35), RoleProbe (FIND_NODE(random)
+every 5 s forever, core.nim:38-55). The regression node reuses the same
+machinery for mesh discovery (regression/kad_utils.nim:81-94).
+
+TPU-native design (not a port):
+  keys[p]           (N, W) uint32 — 128-bit node key, host-generated per seed
+  rtable[p]         (N, B, K) int32 — bucket b holds peers whose XOR distance
+                    to p has bit-length KEY_BITS - b; -1 = empty slot
+  find_node         vmapped iterative lookup: a lax.scan over lookup rounds,
+                    each round queries ALPHA closest unqueried shortlist
+                    entries in parallel (round time = max RTT, per the
+                    iterative-lookup wait-for-all semantics), merges their
+                    K_RESP closest entries via stable multi-word argsort.
+
+Everything is a masked fixed-shape op: shortlists are padded to S entries,
+bucket inserts route dropped entries out of bounds (`mode="drop"`), and
+big-integer XOR comparisons are radix argsorts over the W key words — no
+Python bigints, no dynamic shapes, so the whole lookup batch jits and shards
+over the peer axis like the GossipSub engine.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+KEY_WORDS = 4                    # 128-bit keys; collisions ~ N^2 / 2^129
+KEY_BITS = 32 * KEY_WORDS
+ALPHA = 3                        # parallel queries per lookup round
+K_RESP = 16                      # closest entries returned per FIND_NODE
+PROC_MS = 2.0                    # per-query handler latency
+
+
+def make_keys(n: int, seed: int = 0) -> np.ndarray:
+    """Uniform 128-bit node keys, host-generated once per experiment (the
+    reference derives keys from peer identities; only uniformity matters)."""
+    rng = np.random.default_rng(np.random.SeedSequence([seed, 0x6AD]))
+    return rng.integers(0, 1 << 32, size=(n, KEY_WORDS), dtype=np.uint32)
+
+
+def _bitlen32(x: jnp.ndarray) -> jnp.ndarray:
+    """Bit length of each uint32 lane (0 for 0), via 5-step binary search."""
+    x = x.astype(jnp.uint32)
+    bl = jnp.zeros(x.shape, jnp.int32)
+    for shift in (16, 8, 4, 2, 1):
+        gt = x >= (jnp.uint32(1) << shift)
+        bl = jnp.where(gt, bl + shift, bl)
+        x = jnp.where(gt, x >> shift, x)
+    return bl + (x > 0).astype(jnp.int32)
+
+
+def xor_bitlen(d: jnp.ndarray) -> jnp.ndarray:
+    """Bit length of the big-int whose words (most significant first) are the
+    trailing axis. The first nonzero word strictly dominates, so a max over
+    per-word contributions is exact."""
+    w = jnp.arange(KEY_WORDS)
+    contrib = (KEY_WORDS - 1 - w) * 32 + _bitlen32(d)
+    return jnp.max(jnp.where(d > 0, contrib, 0), axis=-1).astype(jnp.int32)
+
+
+def bucket_slot(d: jnp.ndarray, n_buckets: int) -> jnp.ndarray:
+    """Bucket index for an XOR distance: 0 = farthest half of the keyspace.
+    Distances closer than 2^(KEY_BITS - n_buckets) clamp into the last bucket
+    (astronomically rare for uniform keys at any simulated N)."""
+    return jnp.clip(KEY_BITS - xor_bitlen(d), 0, n_buckets - 1)
+
+
+def lex_argsort(d: jnp.ndarray) -> jnp.ndarray:
+    """Ascending big-int argsort over the trailing word axis of (..., M, W):
+    repeated stable argsorts from least to most significant word (radix)."""
+    idx = jnp.argsort(d[..., -1], axis=-1, stable=True)
+    for w in range(KEY_WORDS - 2, -1, -1):
+        key = jnp.take_along_axis(d[..., w], idx, axis=-1)
+        refine = jnp.argsort(key, axis=-1, stable=True)
+        idx = jnp.take_along_axis(idx, refine, axis=-1)
+    return idx
+
+
+def _dist(keys: jnp.ndarray, entries: jnp.ndarray, target_key: jnp.ndarray):
+    """XOR distance of each entry to target; invalid entries (-1) -> max."""
+    ek = keys[jnp.clip(entries, 0)]
+    d = jnp.bitwise_xor(ek, target_key[..., None, :])
+    return jnp.where((entries >= 0)[..., None], d, jnp.uint32(0xFFFFFFFF))
+
+
+@struct.dataclass
+class KadState:
+    """Device-side DHT state (a jax pytree). keys are per-epoch constants but
+    ride along so every op is self-contained."""
+
+    rtable: jnp.ndarray      # (N, B, K) int32, -1 empty
+    keys: jnp.ndarray        # (N, W) uint32
+    alive: jnp.ndarray       # (N,) bool
+    t_ms: jnp.ndarray        # () float32
+    key: jnp.ndarray         # PRNG key
+    queries_tx: jnp.ndarray  # (N,) int32 FIND_NODE requests sent
+    queries_rx: jnp.ndarray  # (N,) int32 FIND_NODE requests served
+
+
+def init_kad_state(
+    n: int, n_buckets: int = 24, k_bucket: int = 16, seed: int = 0
+) -> KadState:
+    return KadState(
+        rtable=jnp.full((n, n_buckets, k_bucket), -1, dtype=jnp.int32),
+        keys=jnp.asarray(make_keys(n, seed)),
+        alive=jnp.ones((n,), dtype=bool),
+        t_ms=jnp.asarray(0.0, jnp.float32),
+        key=jax.random.PRNGKey(seed ^ 0x6AD),
+        queries_tx=jnp.zeros((n,), jnp.int32),
+        queries_rx=jnp.zeros((n,), jnp.int32),
+    )
+
+
+def _segment_rank(sort_key: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """rank[i] = occurrence index of sort_key[i] among equal keys (array
+    order); jit-friendly analog of graph._cumcount. Returns (rank, order)."""
+    m = sort_key.shape[0]
+    order = jnp.argsort(sort_key, stable=True)
+    sk = sort_key[order]
+    is_start = jnp.concatenate([jnp.ones((1,), bool), sk[1:] != sk[:-1]])
+    start = jax.lax.associative_scan(
+        jnp.maximum, jnp.where(is_start, jnp.arange(m), 0)
+    )
+    rank_sorted = jnp.arange(m) - start
+    rank = jnp.zeros((m,), jnp.int32).at[order].set(rank_sorted.astype(jnp.int32))
+    return rank, order
+
+
+def _insert_one(table: jnp.ndarray, keys: jnp.ndarray, owner: jnp.ndarray,
+                cands: jnp.ndarray) -> jnp.ndarray:
+    """Insert candidate peer ids into one owner's (B, K) table.
+
+    Kademlia bucket policy: keep existing entries (the reference's LRU
+    preference without the ping-eviction probe), append new distinct entries
+    into free slots, drop the rest. Pure fixed-shape: compute each candidate's
+    target (bucket, position) and scatter with out-of-bounds drop."""
+    b, k = table.shape
+    e = cands.shape[0]
+    valid = (cands >= 0) & (cands != owner)
+    d = _dist(keys, cands, keys[owner])
+    slot = bucket_slot(d, b)
+
+    # drop candidates already present in their target bucket
+    in_bucket = table[slot]                      # (E, K)
+    dup_existing = (in_bucket == cands[:, None]).any(axis=-1)
+    # drop repeats within the batch (keep first occurrence)
+    eq = cands[:, None] == cands[None, :]
+    dup_within = (jnp.tril(eq, k=-1)).any(axis=-1)
+    keep = valid & ~dup_existing & ~dup_within
+
+    occupancy = (table >= 0).sum(axis=-1)        # (B,)
+    rank, _ = _segment_rank(jnp.where(keep, slot, b).astype(jnp.int32))
+    pos = occupancy[slot] + rank
+    ok = keep & (pos < k)
+    return table.at[
+        jnp.where(ok, slot, b), jnp.where(ok, pos, 0)
+    ].set(jnp.where(ok, cands, -1).astype(table.dtype), mode="drop")
+
+
+@jax.jit
+def rtable_insert(state: KadState, owners: jnp.ndarray, cands: jnp.ndarray
+                  ) -> KadState:
+    """Batch insert: owners (M,) each learn cands (M, E). Owner rows must be
+    distinct within a batch (callers vmap over distinct lookup origins)."""
+    new_rows = jax.vmap(_insert_one, in_axes=(0, None, 0, 0))(
+        state.rtable[owners], state.keys, owners, cands
+    )
+    return state.replace(rtable=state.rtable.at[owners].set(new_rows))
+
+
+def _closest_from_table(table: jnp.ndarray, keys: jnp.ndarray,
+                        target_key: jnp.ndarray, k_out: int) -> jnp.ndarray:
+    """The K_RESP closest entries of one (B, K) table to target (-1 padded) —
+    a FIND_NODE response (the reference returns the k nearest from the
+    routing table)."""
+    flat = table.reshape(-1)
+    order = lex_argsort(_dist(keys, flat, target_key))
+    best = flat[order[:k_out]]
+    return best
+
+
+@struct.dataclass
+class LookupResult:
+    closest: jnp.ndarray     # (Q, K_RESP) int32 final shortlist heads
+    hops: jnp.ndarray        # (Q,) int32 rounds until convergence
+    latency_ms: jnp.ndarray  # (Q,) float32 wall time of the lookup
+    queried: jnp.ndarray     # (Q, rounds*ALPHA) int32 query log (-1 padded)
+    n_queries: jnp.ndarray   # (Q,) int32 total FIND_NODE requests
+
+
+@partial(jax.jit, static_argnames=("rounds", "shortlist"))
+def find_node(
+    state: KadState,
+    origins: jnp.ndarray,     # (Q,) int32 distinct querying peers
+    targets: jnp.ndarray,     # (Q, W) uint32 target keys
+    stage: jnp.ndarray,       # (N,) int32 topology stage per peer
+    lat_ms: jnp.ndarray,      # (S+1, S+1) float32 stage-pair latency
+    rounds: int = 6,
+    shortlist: int = 32,
+) -> tuple[LookupResult, KadState]:
+    """Batched iterative FIND_NODE (kad-dht/core.nim warmup/probe primitive).
+
+    Each origin walks the XOR metric toward its target: query the ALPHA
+    closest unqueried shortlist peers, merge their K_RESP closest entries,
+    repeat `rounds` times (enough for uniform keys at any simulated N: each
+    round roughly halves the remaining distance). Per-round wall time is the
+    max RTT of the parallel queries, accumulated only while the shortlist
+    still improves — matching the iterative lookup's termination ("no peer
+    closer than the best seen" => stop counting).
+
+    Returns per-origin results plus state with updated tables (origin learns
+    every response entry; queried peers learn the origin) and counters.
+    """
+    n = state.rtable.shape[0]
+    q = origins.shape[0]
+    s = shortlist
+
+    o_key = state.keys[origins]                           # (Q, W)
+    o_stage = stage[origins]
+
+    def response(peer, target_key):
+        """FIND_NODE response of `peer` (masked if dead)."""
+        resp = _closest_from_table(state.rtable[peer], state.keys, target_key,
+                                   K_RESP)
+        return jnp.where(state.alive[peer], resp, -1)
+
+    # seed shortlist from the origin's own table
+    sl0 = jax.vmap(
+        lambda o, t: _closest_from_table(state.rtable[o], state.keys, t, s)
+    )(origins, targets)
+    queried0 = jnp.zeros((q, s), bool)
+
+    def round_body(carry, _):
+        sl, queried, t_acc, hops, nq = carry
+        d = _dist(state.keys, sl, targets)
+        order = lex_argsort(d)                            # (Q, S)
+        rank = jnp.argsort(order, axis=-1)                # distance rank
+        # a node never FIND_NODEs itself over the network, so the origin's
+        # own id (distance 0 on self-lookups) is not a query candidate
+        cand = ((sl >= 0) & ~queried & state.alive[jnp.clip(sl, 0)]
+                & (sl != origins[:, None]))
+        # classic termination: the lookup is done once every entry in the
+        # top-K_RESP head of the shortlist has been queried
+        head_unqueried = (cand & (rank < K_RESP)).any(axis=-1)
+        cand = cand & head_unqueried[:, None]
+        # pick the ALPHA closest unqueried, by distance rank
+        pick_prio = jnp.where(cand, rank, s + 1)
+        pick = (jnp.argsort(jnp.argsort(pick_prio, axis=-1), axis=-1)
+                < ALPHA) & cand                           # (Q, S)
+        any_pick = pick.any(axis=-1)
+
+        # gather the ALPHA picked ids into a dense (Q, ALPHA) block
+        p_order = jnp.argsort(~pick, axis=-1, stable=True)[:, :ALPHA]
+        p_ids = jnp.take_along_axis(jnp.where(pick, sl, -1), p_order, axis=-1)
+
+        resp = jax.vmap(jax.vmap(response, in_axes=(0, None)))(
+            jnp.clip(p_ids, 0), targets
+        )                                                 # (Q, ALPHA, K_RESP)
+        resp = jnp.where((p_ids >= 0)[..., None], resp, -1)
+
+        # round RTT = max over the parallel queries (iterative lookup waits)
+        rtt = 2.0 * lat_ms[o_stage[:, None], stage[jnp.clip(p_ids, 0)]] + PROC_MS
+        rtt = jnp.where(p_ids >= 0, rtt, 0.0)
+        round_ms = rtt.max(axis=-1)
+
+        # merge responses into the shortlist: concat, prefer queried entries
+        # on dedup (sort key = id * 2 + fresh), lex-sort by distance, keep S
+        merged = jnp.concatenate([sl, resp.reshape(q, -1)], axis=-1)
+        mq = jnp.concatenate(
+            [queried | pick, jnp.zeros((q, ALPHA * K_RESP), bool)], axis=-1
+        )
+        # dedup key: id*2 + freshness so the queried copy of an id sorts
+        # first and keeps its flag (ids < 2^30, so int32 is safe)
+        mkey = merged * 2 + jnp.where(mq, 0, 1)
+        dorder = jnp.argsort(mkey, axis=-1, stable=True)
+        msort = jnp.take_along_axis(merged, dorder, axis=-1)
+        qsort = jnp.take_along_axis(mq, dorder, axis=-1)
+        dup = jnp.concatenate(
+            [jnp.zeros((q, 1), bool), msort[:, 1:] == msort[:, :-1]], axis=-1
+        )
+        msort = jnp.where(dup | (msort < 0), -1, msort)
+        md = _dist(state.keys, msort, targets)
+        morder = lex_argsort(md)[:, :s]
+        sl_new = jnp.take_along_axis(msort, morder, axis=-1)
+        q_new = jnp.take_along_axis(qsort & ~dup, morder, axis=-1)
+
+        improved = jnp.any(sl_new != sl, axis=-1) & any_pick
+        t_acc = t_acc + jnp.where(any_pick, round_ms, 0.0)
+        hops = hops + jnp.where(improved, 1, 0)
+        nq = nq + (p_ids >= 0).sum(axis=-1)
+        return (sl_new, q_new, t_acc, hops, nq), p_ids
+
+    zeros_q = jnp.zeros((q,), jnp.float32)
+    (sl, queried, t_acc, hops, nq), picked_seq = jax.lax.scan(
+        round_body,
+        (sl0, queried0, zeros_q, jnp.zeros((q,), jnp.int32),
+         jnp.zeros((q,), jnp.int32)),
+        None,
+        length=rounds,
+    )
+    picked_seq = jnp.moveaxis(picked_seq, 0, 1).reshape(q, -1)  # (Q, R*ALPHA)
+
+    # ---- learning + accounting -------------------------------------------
+    # origin learns its final shortlist (every response it accepted)
+    state = rtable_insert(state, origins, sl)
+    # each queried peer learns the origins that queried it: group the
+    # (learner, origin) events by learner (segment ranks, capacity-bounded)
+    # so parallel lookups hitting the same responder all land
+    flat_peers = picked_seq.reshape(-1)
+    flat_origin = jnp.broadcast_to(origins[:, None], picked_seq.shape).reshape(-1)
+    e_cap = 8
+    rank, _ = _segment_rank(jnp.where(flat_peers >= 0, flat_peers, n))
+    ok = (flat_peers >= 0) & (rank < e_cap)
+    learn_cands = jnp.full((n, e_cap), -1, jnp.int32).at[
+        jnp.where(ok, flat_peers, n), jnp.where(ok, rank, 0)
+    ].set(jnp.where(ok, flat_origin, -1), mode="drop")
+    state = rtable_insert(state, jnp.arange(n, dtype=jnp.int32), learn_cands)
+
+    served = jnp.zeros((n,), jnp.int32).at[
+        jnp.where(flat_peers >= 0, flat_peers, n)
+    ].add(1, mode="drop")
+    state = state.replace(
+        queries_tx=state.queries_tx.at[origins].add(nq),
+        queries_rx=state.queries_rx + served,
+    )
+
+    result = LookupResult(
+        closest=sl[:, :K_RESP], hops=hops, latency_ms=t_acc,
+        queried=picked_seq, n_queries=nq,
+    )
+    return result, state
+
+
+@jax.jit
+def seed_bootstraps(state: KadState, bootstraps: jnp.ndarray) -> KadState:
+    """Every peer seeds its table with the bootstrap anchors and every
+    bootstrap learns every peer — the array form of connectToBootstraps +
+    the bootstrap's passive accumulation (kad-dht/helpers.nim:62-91,
+    regression/kad_utils.nim:88-94)."""
+    n = state.rtable.shape[0]
+    all_peers = jnp.arange(n, dtype=jnp.int32)
+    cands = jnp.broadcast_to(bootstraps[None, :], (n, bootstraps.shape[0]))
+    state = rtable_insert(state, all_peers, cands)
+    # bootstraps learn everyone (batched over bootstraps; N candidates each)
+    nb = bootstraps.shape[0]
+    state = rtable_insert(
+        state, bootstraps, jnp.broadcast_to(all_peers[None, :], (nb, n))
+    )
+    return state
+
+
+def rtable_census(state: KadState) -> jnp.ndarray:
+    """Per-peer routing-table population — the reference's warmup census
+    (kad-dht/core.nim:17-22 'Kad routing table, peers = rtPeers')."""
+    return (state.rtable >= 0).sum(axis=(-1, -2)).astype(jnp.int32)
+
+
+def random_targets(key: jnp.ndarray, q: int) -> jnp.ndarray:
+    """Random lookup targets — getRandomPeerId (kad-dht/helpers.nim:10-12):
+    uniform keys that (almost surely) match no live node."""
+    return jax.random.bits(key, (q, KEY_WORDS), dtype=jnp.uint32)
+
+
+def true_closest(keys: np.ndarray, target: np.ndarray, k: int = 1) -> np.ndarray:
+    """Host-side brute-force ground truth for tests: the k globally closest
+    node indices to target under the XOR metric."""
+    ints = np.zeros(keys.shape[0], dtype=object)
+    t_int = 0
+    for w in range(KEY_WORDS):
+        ints = ints * (1 << 32) + keys[:, w].astype(object)
+        t_int = t_int * (1 << 32) + int(target[w])
+    d = np.array([x ^ t_int for x in ints], dtype=object)
+    return np.argsort(d, kind="stable")[:k]

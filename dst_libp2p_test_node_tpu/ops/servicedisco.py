@@ -1,0 +1,265 @@
+"""Service discovery over the DHT: advertise/lookup as batched array ops.
+
+The reference service-discovery node (nim-test-node/service-discovery/
+{main,core,env,helpers}.nim) exercises libp2p's service_discovery protocol on
+top of kad-dht: RoleAdvertiser nodes `startAdvertising(ServiceInfo(id,data))`
+(core.nim:7-16), RoleDiscoverer nodes run a periodic `lookup(hashServiceId)`
+loop logging advertisement counts and unique providers (core.nim:30-53),
+RoleHybrid does both, RoleBootstrap anchors the DHT. Tunables: safetyParam,
+ipSimCoefficient, advertExpiry, xprPublishing (env.nim:120-140).
+
+TPU-native design on the ops/kad substrate:
+
+  service keys    hash of the service id string -> the same 128-bit keyspace
+                  as node keys (host-side, stable across runs)
+  advert store    (N, A) record slots per node: provider id, service index,
+                  seqNo, expiry timestamp — fixed capacity, expired slots
+                  are reusable (the array analog of the provider record TTL)
+  advertise wave  one find_node() toward the service key per (advertiser,
+                  service), then a scatter of provider records into the R
+                  closest nodes' stores, R = k_store * (1 + safetyParam)
+                  (the safety widening), with ipSimCoefficient demoting
+                  same-stage replicas (the IP-similarity spread heuristic —
+                  modeled: stage is our IP-locality analog)
+  lookup wave     one find_node() per (discoverer, service), then a gather
+                  of matching unexpired records from the R closest nodes;
+                  result = advertisement count + unique provider count
+                  (core.nim:40-52's HashSet dedup)
+
+Latency accounting: advertise/lookup cost = the underlying lookup's RTT walk
+plus one more round trip to store/fetch records. xprPublishing toggles the
+record payload size used for byte accounting (extended peer records carry
+addresses; core ads only the peer id).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from . import kad
+
+
+def service_key(service_id: str) -> np.ndarray:
+    """hashServiceId: a stable 128-bit key for a service id string."""
+    h = hashlib.sha256(service_id.encode()).digest()
+    return np.frombuffer(h[:16], dtype=">u4").astype(np.uint32)
+
+
+# record payload sizes for byte accounting (xprPublishing, env.nim:138-140)
+AD_BYTES_CORE = 64       # peerId + seqNo + signature envelope
+AD_BYTES_XPR = 256       # extended peer record: + addresses
+
+
+@dataclass(frozen=True)
+class SDParams:
+    """Static service-discovery parameters (env.nim:120-184 surface)."""
+
+    k_store: int = 8                 # base replication of provider records
+    safety_param: float = 0.0        # SD_SAFETY_PARAM: widens replication
+    ip_sim_coefficient: float = 0.0  # SD_IP_SIM_COEFF: same-stage demotion
+    advert_expiry_ms: float = 900_000.0  # SD_ADVERT_EXPIRY_SECONDS default
+    xpr_publishing: bool = True      # SD_XPR_PUBLISHING
+
+    @property
+    def replication(self) -> int:
+        return max(1, int(round(self.k_store * (1.0 + self.safety_param))))
+
+    @property
+    def ad_bytes(self) -> int:
+        return AD_BYTES_XPR if self.xpr_publishing else AD_BYTES_CORE
+
+
+@struct.dataclass
+class AdvertStore:
+    """Per-node provider-record store (fixed capacity A per node)."""
+
+    provider: jnp.ndarray   # (N, A) int32, -1 empty
+    service: jnp.ndarray    # (N, A) int32 service index
+    seq_no: jnp.ndarray     # (N, A) int32
+    expires_ms: jnp.ndarray  # (N, A) float32
+
+
+def init_advert_store(n: int, capacity: int = 64) -> AdvertStore:
+    return AdvertStore(
+        provider=jnp.full((n, capacity), -1, jnp.int32),
+        service=jnp.full((n, capacity), -1, jnp.int32),
+        seq_no=jnp.zeros((n, capacity), jnp.int32),
+        expires_ms=jnp.zeros((n, capacity), jnp.float32),
+    )
+
+
+def _store_one(store_row, now_ms, provider, service, seq_no, expiry_ms, write):
+    """Insert/refresh one provider record in one node's store row.
+
+    Same (provider, service) refreshes in place (seqNo bump, new expiry);
+    otherwise the record takes the first free-or-expired slot; a full store
+    drops the record (bounded capacity is the DoS guard the reference
+    inherits from the provider-record TTL store)."""
+    prov, svc, seq, exp = store_row
+    match = (prov == provider) & (svc == service)
+    free = (prov < 0) | (exp <= now_ms)
+    has_match = match.any()
+    # first matching slot, else first free slot
+    slot_match = jnp.argmax(match)
+    slot_free = jnp.argmax(free)
+    slot = jnp.where(has_match, slot_match, slot_free)
+    ok = write & (has_match | free.any())
+    a = prov.shape[0]
+    idx = jnp.where(ok, slot, a)
+    prov = prov.at[idx].set(provider, mode="drop")
+    svc = svc.at[idx].set(service, mode="drop")
+    seq = seq.at[idx].set(seq_no, mode="drop")
+    exp = exp.at[idx].set(now_ms + expiry_ms, mode="drop")
+    return prov, svc, seq, exp
+
+
+@partial(jax.jit, static_argnames=("params",))
+def advertise(
+    store: AdvertStore,
+    kstate: kad.KadState,
+    advertisers: jnp.ndarray,    # (Q,) int32 distinct advertiser peers
+    service_idx: jnp.ndarray,    # (Q,) int32 service index per advertiser
+    service_keys: jnp.ndarray,   # (S, W) uint32 key per service index
+    seq_no: jnp.ndarray,         # (Q,) int32 current sequence numbers
+    stage: jnp.ndarray,
+    lat_ms: jnp.ndarray,
+    now_ms,
+    params: SDParams,
+) -> tuple[AdvertStore, kad.KadState, jnp.ndarray]:
+    """One advertise wave: locate the R closest nodes to each service key and
+    place provider records there. Returns (store, kstate, wave_latency_ms)."""
+    targets = service_keys[service_idx]
+    res, kstate = kad.find_node(kstate, advertisers, targets, stage, lat_ms)
+    closest = res.closest                        # (Q, K_RESP)
+
+    # replica selection: closest first, same-stage-as-advertiser entries
+    # demoted by ipSimCoefficient (stage = IP-locality analog)
+    q = advertisers.shape[0]
+    k = closest.shape[1]
+    base_rank = jnp.broadcast_to(
+        jnp.arange(k, dtype=jnp.float32)[None, :], (q, k)
+    )
+    same_stage = stage[jnp.clip(closest, 0)] == stage[advertisers][:, None]
+    demoted = base_rank + params.ip_sim_coefficient * same_stage * k
+    demoted = jnp.where(closest >= 0, demoted, jnp.float32(1e9))
+    order = jnp.argsort(demoted, axis=-1)
+    replicas = jnp.take_along_axis(closest, order, axis=-1)[
+        :, : params.replication
+    ]                                            # (Q, R)
+
+    # scatter records into replica stores, grouped by storing node
+    flat_node = replicas.reshape(-1)
+    flat_prov = jnp.broadcast_to(
+        advertisers[:, None], replicas.shape
+    ).reshape(-1)
+    flat_svc = jnp.broadcast_to(
+        service_idx[:, None], replicas.shape
+    ).reshape(-1)
+    flat_seq = jnp.broadcast_to(seq_no[:, None], replicas.shape).reshape(-1)
+
+    def apply_event(i, rows):
+        prov, svc, seq, exp = rows
+        node = flat_node[i]
+        ok = node >= 0
+        nrow = jnp.clip(node, 0)
+        new = _store_one(
+            (prov[nrow], svc[nrow], seq[nrow], exp[nrow]),
+            now_ms, flat_prov[i], flat_svc[i], flat_seq[i],
+            params.advert_expiry_ms, ok,
+        )
+        return (
+            prov.at[nrow].set(jnp.where(ok, new[0], prov[nrow])),
+            svc.at[nrow].set(jnp.where(ok, new[1], svc[nrow])),
+            seq.at[nrow].set(jnp.where(ok, new[2], seq[nrow])),
+            exp.at[nrow].set(jnp.where(ok, new[3], exp[nrow])),
+        )
+
+    rows = (store.provider, store.service, store.seq_no, store.expires_ms)
+    # sequential fori over store events: events can collide on a node, and
+    # the per-wave event count (Q*R) is small; each step is a tiny gather +
+    # scatter, so the scan stays on-device with no host sync
+    rows = jax.lax.fori_loop(0, flat_node.shape[0], apply_event, rows)
+    store = AdvertStore(
+        provider=rows[0], service=rows[1], seq_no=rows[2], expires_ms=rows[3]
+    )
+
+    # advertise latency = lookup walk + one store round trip to the farthest
+    # chosen replica
+    rep_lat = 2.0 * lat_ms[stage[advertisers][:, None],
+                           stage[jnp.clip(replicas, 0)]]
+    rep_lat = jnp.where(replicas >= 0, rep_lat, 0.0)
+    wave_ms = res.latency_ms + rep_lat.max(axis=-1)
+    return store, kstate, wave_ms
+
+
+@struct.dataclass
+class SDLookupResult:
+    advertisements: jnp.ndarray  # (Q,) int32 records found
+    unique_peers: jnp.ndarray    # (Q,) int32 distinct providers
+    latency_ms: jnp.ndarray      # (Q,) float32
+
+
+@partial(jax.jit, static_argnames=("params",))
+def lookup(
+    store: AdvertStore,
+    kstate: kad.KadState,
+    discoverers: jnp.ndarray,    # (Q,) int32
+    service_idx: jnp.ndarray,    # (Q,) int32
+    service_keys: jnp.ndarray,   # (S, W) uint32
+    stage: jnp.ndarray,
+    lat_ms: jnp.ndarray,
+    now_ms,
+    params: SDParams,
+) -> tuple[SDLookupResult, kad.KadState]:
+    """One lookup wave (runLookupLoop body, core.nim:30-53): walk to the
+    service key, fetch matching unexpired records from the R closest nodes,
+    count advertisements and unique providers."""
+    targets = service_keys[service_idx]
+    res, kstate = kad.find_node(kstate, discoverers, targets, stage, lat_ms)
+    replicas = res.closest[:, : params.replication]      # (Q, R)
+
+    rows = jnp.clip(replicas, 0)
+    prov = store.provider[rows]                          # (Q, R, A)
+    svc = store.service[rows]
+    exp = store.expires_ms[rows]
+    valid = ((replicas >= 0)[..., None] & (prov >= 0)
+             & (svc == service_idx[:, None, None]) & (exp > now_ms))
+    ads = valid.sum(axis=(-1, -2)).astype(jnp.int32)
+
+    # unique providers: flatten (R, A), sort, count first occurrences
+    q = discoverers.shape[0]
+    flat = jnp.where(valid, prov, jnp.int32(2**30)).reshape(q, -1)
+    srt = jnp.sort(flat, axis=-1)
+    first = jnp.concatenate(
+        [jnp.ones((q, 1), bool), srt[:, 1:] != srt[:, :-1]], axis=-1
+    )
+    uniq = (first & (srt < 2**30)).sum(axis=-1).astype(jnp.int32)
+
+    rep_lat = 2.0 * lat_ms[stage[discoverers][:, None],
+                           stage[jnp.clip(replicas, 0)]]
+    rep_lat = jnp.where(replicas >= 0, rep_lat, 0.0)
+    out = SDLookupResult(
+        advertisements=ads,
+        unique_peers=uniq,
+        latency_ms=res.latency_ms + rep_lat.max(axis=-1),
+    )
+    return out, kstate
+
+
+@jax.jit
+def expire_sweep(store: AdvertStore, now_ms) -> AdvertStore:
+    """Drop expired records (advertExpiry TTL) — run between waves."""
+    live = (store.provider >= 0) & (store.expires_ms > now_ms)
+    return AdvertStore(
+        provider=jnp.where(live, store.provider, -1),
+        service=jnp.where(live, store.service, -1),
+        seq_no=jnp.where(live, store.seq_no, 0),
+        expires_ms=jnp.where(live, store.expires_ms, 0.0),
+    )

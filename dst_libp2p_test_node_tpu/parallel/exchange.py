@@ -1,0 +1,181 @@
+"""Hand-tuned cross-shard exchange for the dissemination fixpoint.
+
+The reference's cross-peer traffic is TCP/QUIC sockets between processes;
+sharded across TPU chips, a mesh edge whose endpoints live on different
+shards must move data over ICI (SURVEY.md §2 parallelism table). The naive
+formulation (ops/disseminate.py's sender-side `offers` + `pull`) reads the
+full (N, C) candidate matrix across shards every fixpoint iteration; under
+XLA auto-partitioning that becomes repeated all-gathers of C floats per peer.
+
+This module reformulates the fixpoint receiver-side so the ONLY cross-shard
+value is the (N,) arrival-time vector — 4 bytes/peer/iteration over ICI:
+
+    inc[q, j] = t_rx[p] + A[q, j]                          (mesh edges)
+    inc[q, j] = nextHB(t_rx[p] + proc, phase[p]) + G[q, j] (gossip edges)
+    t_rx'[q]  = min(t_rx[q], min_j inc[q, j])     with p = conns[q, j]
+
+where A and G are per-edge constants (uplink-serialization rank, stage
+latency, tx time) gathered ONCE through the reverse-slot map before the
+loop. Both the everything-on-one-shard path and the `shard_map` path run the
+same expression; the sharded variant all-gathers t_rx and psums the
+convergence flag, so XLA emits exactly one small collective pair per
+iteration — the ICI-riding design the scaling recipe calls for (mesh ->
+shardings -> let XLA insert collectives).
+
+Equivalence to the sender-side formulation is exact: offers are affine in
+the sender's arrival time for mesh edges, and the gossip term only needs
+t_rx[p] and the sender's heartbeat phase (see test_exchange.py).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+INF = jnp.float32(3.4e38)
+
+PEER_AXIS = "peers"
+
+
+@struct.dataclass
+class RecvConstants:
+    """Per-receiver-slot constants of one fixpoint (fragment x phase)."""
+
+    src: jnp.ndarray        # (N, C) int32 sender peer id (conns), -1 pad
+    a_ms: jnp.ndarray       # (N, C) float32 mesh-edge additive constant
+    mesh_ok: jnp.ndarray    # (N, C) bool mesh edge active
+    g_ms: jnp.ndarray       # (N, C) float32 gossip additive constant
+    g_ok: jnp.ndarray       # (N, C) bool gossip edge active
+    phase: jnp.ndarray      # (N, C) float32 sender heartbeat phase
+    proc_ms: jnp.ndarray    # () float32
+    hb_ms: jnp.ndarray      # () float32
+
+
+def _edge_gather(sender_val: jnp.ndarray, conns: jnp.ndarray,
+                 rev: jnp.ndarray) -> jnp.ndarray:
+    """recv[q, j] = sender_val[conns[q,j], rev[q,j]] (one-time gather)."""
+    return sender_val[jnp.clip(conns, 0), jnp.clip(rev, 0)]
+
+
+def build_recv_constants(
+    conns: jnp.ndarray,
+    rev: jnp.ndarray,
+    lat_edge: jnp.ndarray,      # (N, C) sender-side per-slot latency
+    tx_ms: jnp.ndarray,         # (N,) sender uplink ms per fragment
+    rank: jnp.ndarray,          # (N, C) sender-side send order
+    k_p: jnp.ndarray,           # (N,) sender fanout size
+    frag_idx,
+    send_mask: jnp.ndarray,     # (N, C) sender-side forwarding mask
+    can_send: jnp.ndarray,      # (N,) alive & subscribed
+    g_tgt: jnp.ndarray,         # (N, C) sender-side gossip targets
+    hb_phase: jnp.ndarray,      # (N,) heartbeat phase
+    proc_ms: float,
+    hb_ms: float,
+    with_gossip: bool,
+) -> RecvConstants:
+    """Gather every sender-side term of ops/disseminate.offers through the
+    reverse-slot map once, leaving a fixpoint that touches only t_rx."""
+    valid = (conns >= 0) & (rev >= 0)
+    queue = (rank + 1.0 + frag_idx * k_p[:, None]) * tx_ms[:, None]
+    a_sender = proc_ms + queue + lat_edge              # offers minus t_rx
+    a_ms = jnp.where(valid, _edge_gather(a_sender, conns, rev), INF)
+    mesh_ok = valid & _edge_gather(
+        send_mask & can_send[:, None], conns, rev)
+
+    if with_gossip:
+        g_sender = 3.0 * lat_edge + tx_ms[:, None]
+        g_ms = jnp.where(valid, _edge_gather(g_sender, conns, rev), INF)
+        g_ok = valid & _edge_gather(g_tgt & can_send[:, None], conns, rev)
+    else:
+        g_ms = jnp.full_like(a_ms, INF)
+        g_ok = jnp.zeros_like(mesh_ok)
+    phase = _edge_gather(
+        jnp.broadcast_to(hb_phase[:, None], conns.shape), conns, rev)
+    return RecvConstants(
+        src=jnp.where(valid, conns, -1),
+        a_ms=a_ms,
+        mesh_ok=mesh_ok,
+        g_ms=g_ms,
+        g_ok=g_ok,
+        phase=phase,
+        proc_ms=jnp.float32(proc_ms),
+        hb_ms=jnp.float32(hb_ms),
+    )
+
+
+def _inc_from(t_all: jnp.ndarray, c: RecvConstants) -> jnp.ndarray:
+    """Incoming offers of every receiver slot given the global t_rx."""
+    t_src = t_all[jnp.clip(c.src, 0)]
+    live = (c.src >= 0) & (t_src < INF)
+    inc = jnp.where(c.mesh_ok & live, t_src + c.a_ms, INF)
+    base = t_src + c.proc_ms
+    hb = (jnp.floor((base - c.phase) / c.hb_ms) + 1.0) * c.hb_ms + c.phase
+    inc_g = jnp.where(c.g_ok & live, hb + c.g_ms, INF)
+    return jnp.minimum(inc, inc_g)
+
+
+def converge_recv(
+    t0: jnp.ndarray, c: RecvConstants, max_iters: int
+) -> jnp.ndarray:
+    """Single-shard receiver-side fixpoint (reference for the sharded one)."""
+
+    def cond(carry):
+        _, changed, it = carry
+        return changed & (it < max_iters)
+
+    def body(carry):
+        t_rx, _, it = carry
+        t_new = jnp.minimum(t_rx, _inc_from(t_rx, c).min(axis=-1))
+        return t_new, jnp.any(t_new < t_rx), it + 1
+
+    t_rx, _, _ = jax.lax.while_loop(cond, body, (t0, jnp.bool_(True), 0))
+    return t_rx
+
+
+def converge_sharded(
+    t0: jnp.ndarray, c: RecvConstants, max_iters: int, mesh: Mesh
+) -> jnp.ndarray:
+    """shard_map fixpoint over the peer axis: rows of the constants live on
+    their shard; each iteration all-gathers the (N,) time vector over ICI
+    and psums one convergence bit. Identical results to converge_recv."""
+    rows = P(PEER_AXIS)
+
+    def local_fix(t0_l, src, a_ms, mesh_ok, g_ms, g_ok, phase):
+        c_l = RecvConstants(
+            src=src, a_ms=a_ms, mesh_ok=mesh_ok, g_ms=g_ms, g_ok=g_ok,
+            phase=phase, proc_ms=c.proc_ms, hb_ms=c.hb_ms,
+        )
+
+        def cond(carry):
+            _, changed, it = carry
+            return changed & (it < max_iters)
+
+        def body(carry):
+            t_l, _, it = carry
+            t_all = jax.lax.all_gather(t_l, PEER_AXIS, tiled=True)
+            t_new = jnp.minimum(t_l, _inc_from(t_all, c_l).min(axis=-1))
+            changed = jax.lax.psum(
+                jnp.any(t_new < t_l).astype(jnp.int32), PEER_AXIS) > 0
+            return t_new, changed, it + 1
+
+        t_l, _, _ = jax.lax.while_loop(cond, body, (t0_l, jnp.bool_(True), 0))
+        return t_l
+
+    fn = jax.shard_map(
+        local_fix,
+        mesh=mesh,
+        in_specs=(rows, rows, rows, rows, rows, rows, rows),
+        out_specs=rows,
+    )
+    return fn(t0, c.src, c.a_ms, c.mesh_ok, c.g_ms, c.g_ok, c.phase)
+
+
+def place_sharded(mesh: Mesh, *arrays):
+    """Put (N, ...) arrays row-sharded on the peer mesh."""
+    sh = NamedSharding(mesh, P(PEER_AXIS))
+    out = tuple(jax.device_put(a, sh) for a in arrays)
+    return out if len(out) > 1 else out[0]

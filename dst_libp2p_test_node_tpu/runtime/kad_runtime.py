@@ -1,0 +1,283 @@
+"""kad-dht experiment runtime: the role-based DHT workload as sim phases.
+
+Replays the reference kad-dht node's role program (kad-dht/main.nim:15-72)
+against the batched Kademlia substrate (ops/kad.py):
+
+  RoleBootstrap  passive anchors: seeded into every table, serve queries
+                 (main.nim:34-38)
+  RoleNormal     startup jitter myId*200 ms, connect to bootstraps, warmup =
+                 5x FIND_NODE(self) @ 1 s + 15x FIND_NODE(random) @ 2 s
+                 (core.nim:12-35), then idle steady state
+  RoleProbe      jitter + bootstrap connect, then FIND_NODE(random) every 5 s
+                 with a 30 s timeout, forever (core.nim:38-55)
+
+One OS process per role in the reference becomes one batched lookup wave per
+phase tick here: all normal nodes' warmup iteration i is a single find_node()
+call over the normal-role origins, all probe ticks one call over the probe
+origins. Log lines mirror the chronicles output (notice/debug key=value) so
+the same eyeballs-and-grep workflow applies; the summary aggregates what the
+reference leaves implicit in logs (census, hops, lookup latency, probe
+success under the 30 s timeout).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..config.topology import Topology, TopoParams
+from ..ops import kad
+
+
+@dataclass
+class KadConfig:
+    network_size: int = 100
+    n_bootstrap: int = 3          # RoleBootstrap anchors (ids 0..n_bootstrap-1)
+    n_probe: int = 10             # RoleProbe tail (highest ids)
+    discovery: str = "kad-dht"    # DISCOVERY: kad-dht | extended (env.nim:29)
+    muxer: str = "yamux"
+    probe_duration_s: float = 60.0
+    probe_period_s: float = 5.0   # core.nim:55
+    probe_timeout_s: float = 30.0  # core.nim:47
+    seed: int = 0
+    topo: TopoParams | None = None
+    n_buckets: int = 24
+    k_bucket: int = 16
+
+    def validate(self) -> None:
+        if self.discovery not in ("kad-dht", "extended"):
+            raise ValueError(f"Unknown DISCOVERY: {self.discovery}")
+        if self.n_bootstrap < 1:
+            raise ValueError("need at least one bootstrap")
+        if self.n_probe < 0:
+            raise ValueError("n_probe must be >= 0")
+        if self.n_bootstrap + self.n_probe > self.network_size:
+            raise ValueError("roles exceed network size")
+
+
+@dataclass
+class LookupRecord:
+    origin: int
+    target_hex: str
+    self_lookup: bool
+    hops: int
+    latency_ms: float
+    n_queries: int
+    timed_out: bool
+
+
+@dataclass
+class KadSummary:
+    census_mean: float
+    census_min: int
+    census_max: int
+    warmup_lookups: int
+    probe_lookups: int
+    probe_success: int
+    lookup_latency_ms_p50: float
+    lookup_latency_ms_p99: float
+    hops_mean: float
+    queries_per_bootstrap: float
+
+    def report(self) -> str:
+        to = self.probe_lookups - self.probe_success
+        return "\n".join([
+            "Kad-DHT summary",
+            f"Routing table census: mean {self.census_mean:.1f} "
+            f"(min {self.census_min}, max {self.census_max})",
+            f"Warmup lookups: {self.warmup_lookups}",
+            f"Probe lookups: {self.probe_lookups} "
+            f"({self.probe_success} ok, {to} timed out)",
+            f"Lookup latency ms: p50 {self.lookup_latency_ms_p50:.0f} "
+            f"p99 {self.lookup_latency_ms_p99:.0f}",
+            f"Lookup hops: mean {self.hops_mean:.2f}",
+            f"FIND_NODE served per bootstrap: {self.queries_per_bootstrap:.0f}",
+        ])
+
+
+class KadSimulator:
+    """Batched role-program driver over ops/kad (one instance per run)."""
+
+    def __init__(self, cfg: KadConfig):
+        import jax
+        import jax.numpy as jnp
+
+        cfg.validate()
+        self.cfg = cfg
+        n = cfg.network_size
+        topo = cfg.topo or TopoParams(
+            network_size=n, muxer=cfg.muxer, msg_size_bytes=100
+        )
+        self.topology = Topology.build(topo)
+        self._stage = jnp.asarray(self.topology.stage_of_peer)
+        self._lat = jnp.asarray(self.topology.latency_ms)
+        self.state = kad.init_kad_state(
+            n, n_buckets=cfg.n_buckets, k_bucket=cfg.k_bucket, seed=cfg.seed
+        )
+        self._probe_key = jax.random.PRNGKey(cfg.seed ^ 0x9406E)
+        self.bootstraps = jnp.arange(cfg.n_bootstrap, dtype=jnp.int32)
+        self.normals = jnp.arange(
+            cfg.n_bootstrap, n - cfg.n_probe, dtype=jnp.int32
+        )
+        self.probes = jnp.arange(n - cfg.n_probe, n, dtype=jnp.int32)
+        self.t_ms = 0.0
+        self.lines: list[str] = []
+        self.lookups: list[LookupRecord] = []
+
+    # ------------------------------------------------------------------ util
+
+    def _log(self, line: str) -> None:
+        self.lines.append(line)
+
+    def _key_hex(self, key_row: np.ndarray) -> str:
+        return "".join(f"{int(w):08x}" for w in key_row)
+
+    def _record_wave(self, origins, targets, res, self_lookup: bool) -> None:
+        o = np.asarray(origins)
+        hops = np.asarray(res.hops)
+        lat = np.asarray(res.latency_ms)
+        nq = np.asarray(res.n_queries)
+        tg = np.asarray(targets)
+        timeout_ms = self.cfg.probe_timeout_s * 1000.0
+        for i in range(len(o)):
+            self.lookups.append(LookupRecord(
+                origin=int(o[i]),
+                target_hex=self._key_hex(tg[i]),
+                self_lookup=self_lookup,
+                hops=int(hops[i]),
+                latency_ms=float(lat[i]),
+                n_queries=int(nq[i]),
+                timed_out=bool(lat[i] > timeout_ms),
+            ))
+
+    # ---------------------------------------------------------------- phases
+
+    def boot(self) -> None:
+        """Node starts + jittered bootstrap connects (main.nim:28-47). The
+        per-node jitter (myId*200 ms) staggers dials; batched seeding is its
+        fixed point — every node ends with the anchors in its table."""
+        cfg = self.cfg
+        for b in range(cfg.n_bootstrap):
+            self._log(f"Node started peer={b} role=RoleBootstrap "
+                      f"discovery={cfg.discovery}")
+        self.state = kad.seed_bootstraps(self.state, self.bootstraps)
+        max_jitter = (cfg.network_size - 1) * 200.0
+        self.t_ms += max_jitter + 10_000.0  # jitter + dial/backoff envelope
+        n_conn = cfg.network_size - cfg.n_bootstrap
+        self._log(f"Connected to bootstrap nodes={n_conn} "
+                  f"anchors={cfg.n_bootstrap}")
+
+    def warmup(self) -> None:
+        """5x FIND_NODE(self) @ 1 s + 15x FIND_NODE(random) @ 2 s over all
+        RoleNormal nodes (core.nim:12-35)."""
+        import jax
+
+        origins = self.normals
+        if origins.shape[0] == 0:
+            return
+        self._log("Starting warmup phase")
+        for i in range(1, 6):
+            res, self.state = kad.find_node(
+                self.state, origins, self.state.keys[origins],
+                self._stage, self._lat,
+            )
+            self._record_wave(origins, self.state.keys[origins], res, True)
+            census = np.asarray(kad.rtable_census(self.state))
+            self._log(f"Warmup: Finding self iteration={i}")
+            self._log(
+                f"Kad routing table peers={census.mean():.1f} "
+                f"buckets={self.cfg.n_buckets}"
+            )
+            self.t_ms += 1000.0
+        for i in range(1, 16):
+            self._probe_key, k = jax.random.split(self._probe_key)
+            targets = kad.random_targets(k, origins.shape[0])
+            res, self.state = kad.find_node(
+                self.state, origins, targets, self._stage, self._lat
+            )
+            self._record_wave(origins, targets, res, False)
+            self._log(f"Warmup: Finding random node iteration={i}")
+            self.t_ms += 2000.0
+        self._log("Warmup complete")
+
+    def probe(self, duration_s: float | None = None) -> None:
+        """FIND_NODE(random) every probe_period_s over all RoleProbe nodes
+        (core.nim:38-55); a lookup exceeding the 30 s timeout is a
+        'Probe Failed'."""
+        import jax
+
+        cfg = self.cfg
+        origins = self.probes
+        if origins.shape[0] == 0:
+            return
+        self._log("Starting probe loop")
+        dur = duration_s if duration_s is not None else cfg.probe_duration_s
+        ticks = max(int(dur / cfg.probe_period_s), 1)
+        for _ in range(ticks):
+            self._probe_key, k = jax.random.split(self._probe_key)
+            targets = kad.random_targets(k, origins.shape[0])
+            res, self.state = kad.find_node(
+                self.state, origins, targets, self._stage, self._lat
+            )
+            self._record_wave(origins, targets, res, False)
+            lat = np.asarray(res.latency_ms)
+            tg = np.asarray(targets)
+            for i in range(origins.shape[0]):
+                t_hex = self._key_hex(tg[i])[:16]
+                if lat[i] > cfg.probe_timeout_s * 1000.0:
+                    self._log(f"Probe Failed target={t_hex} success=false")
+                else:
+                    self._log(f"Probe: Finding node target={t_hex}")
+            self.t_ms += cfg.probe_period_s * 1000.0
+
+    def run(self) -> KadSummary:
+        self.boot()
+        self.warmup()
+        self.probe()
+        return self.summary()
+
+    # --------------------------------------------------------------- outputs
+
+    def summary(self) -> KadSummary:
+        census = np.asarray(kad.rtable_census(self.state))
+        probes = [r for r in self.lookups if not r.self_lookup
+                  and r.origin >= int(self.probes[0])] if len(self.probes) \
+            else []
+        warm = [r for r in self.lookups if r.origin < int(self.probes[0])] \
+            if len(self.probes) else self.lookups
+        lats = np.array([r.latency_ms for r in self.lookups]) \
+            if self.lookups else np.zeros(1)
+        hops = np.array([r.hops for r in self.lookups]) \
+            if self.lookups else np.zeros(1)
+        served = np.asarray(self.state.queries_rx)
+        return KadSummary(
+            census_mean=float(census.mean()),
+            census_min=int(census.min()),
+            census_max=int(census.max()),
+            warmup_lookups=len(warm),
+            probe_lookups=len(probes),
+            probe_success=sum(1 for r in probes if not r.timed_out),
+            lookup_latency_ms_p50=float(np.percentile(lats, 50)),
+            lookup_latency_ms_p99=float(np.percentile(lats, 99)),
+            hops_mean=float(hops.mean()),
+            queries_per_bootstrap=float(
+                served[: self.cfg.n_bootstrap].mean()
+            ) if self.cfg.n_bootstrap else 0.0,
+        )
+
+
+def config_from_env() -> KadConfig:
+    """NODE_ROLE/DISCOVERY/MUXER env surface (kad-dht/env.nim:8-35) mapped to
+    a whole-experiment config (the per-process NODE_ROLE becomes role counts:
+    the simulator owns every role at once)."""
+    from ..config.env import env_int, env_str
+
+    return KadConfig(
+        network_size=env_int("PEERS", 100),
+        n_bootstrap=env_int("KAD_BOOTSTRAPS", 3),
+        n_probe=env_int("KAD_PROBES", 10),
+        discovery=env_str("DISCOVERY", "kad-dht"),
+        muxer=env_str("MUXER", "yamux"),
+        seed=env_int("SEED", 0),
+    )

@@ -1,0 +1,285 @@
+"""Regression-node runtime: GossipSub over kad-dht discovery + mesh pings.
+
+The reference regression node (nim-test-node/regression/{main,env,ping_utils,
+kad_utils}.nim) runs the same GossipSub publish/receive core as the flagship
+node but forms its mesh through Kademlia bootstrap instead of static dials:
+
+  RoleBootstrap   kad-dht anchor only — no GossipSub (main.nim:219-223)
+  RoleNormal      mount GossipSub(+ping)+kad -> STARTSLEEP (180 s default,
+                  env.nim:15) -> dial bootstrap -> seedBootstraps: updatePeers
+                  + kad.bootstrap(forceRefresh) (kad_utils.nim:88-94) ->
+                  mesh grafts from DHT-discovered connections ->
+                  pingMeshLoop: every 45 s ping each mesh peer, logging
+                  dial/ping ms (ping_utils.nim:8-15, 23-87)
+
+GossipSub params differ slightly from the flagship (main.nim:141-152:
+dScore=6, dOut=3, no env overrides) — captured here as defaults.
+
+TPU mapping: the discovery phase runs batched FIND_NODE waves (ops/kad) —
+one self-lookup "bootstrap round" (forceRefresh) plus warmup randoms — and
+the connection graph for GossipSub is then sampled from each node's ROUTING
+TABLE (the reference grafts from DHT-discovered conns, kad_utils.nim:8-11)
+instead of the flagship's uniform shuffle-dials. Dissemination and heartbeat
+then reuse the standard engine. Mesh pings are array ops: RTT per mesh edge
+from the stage latency matrix + muxer processing, logged in the reference's
+"mesh ping" key=value shape.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..config.env import GossipSubParams, env_int, env_str
+from ..config.topology import Topology, TopoParams
+from ..ops import kad
+from ..ops.graph import ConnGraph, build_connection_graph
+from .simulator import ExperimentConfig, MessageRecord, Simulator
+
+MESH_PING_INTERVAL_S = 45.0     # ping_utils.nim:9
+MESH_PING_TIMEOUT_MS = 4000.0   # ping_utils.nim:10
+
+
+def regression_gossipsub_params() -> GossipSubParams:
+    """The regression node's fixed GossipSub tuning (main.nim:141-152)."""
+    return GossipSubParams(d=6, d_low=4, d_high=8, d_score=6, d_out=3,
+                           d_lazy=6)
+
+
+@dataclass
+class RegressionConfig:
+    network_size: int = 100
+    n_bootstrap: int = 1
+    connect_to: int = 10
+    start_sleep_s: float = 180.0      # STARTSLEEP (env.nim:15)
+    discovery_rounds: int = 3         # bootstrap + warmup lookup waves
+    muxer: str = "yamux"
+    fragments: int = 1                # FRAGMENTS
+    msg_size: int = 1000
+    messages: int = 10
+    delay_seconds: float = 4.0
+    ping_rounds: int = 2              # pingMeshLoop iterations to simulate
+    seed: int = 0
+    topo: TopoParams | None = None
+
+    def validate(self) -> None:
+        if self.n_bootstrap < 1:
+            raise ValueError("need at least one bootstrap")
+        if self.n_bootstrap + self.connect_to >= self.network_size:
+            raise ValueError("connect_to too large for network size")
+
+
+@dataclass
+class PingRecord:
+    peer: int
+    target: int
+    ping_ms: float
+
+
+@dataclass
+class RegressionSummary:
+    census_mean: float
+    mesh_degree_mean: float
+    coverage: float
+    ping_count: int
+    ping_ms_p50: float
+    ping_ms_p99: float
+    ping_timeouts: int
+
+    def report(self) -> str:
+        return "\n".join([
+            "Regression summary",
+            f"Routing table census: mean {self.census_mean:.1f}",
+            f"Mesh degree: mean {self.mesh_degree_mean:.1f}",
+            f"Coverage: {self.coverage * 100.0:.1f}%",
+            f"Mesh pings: {self.ping_count} "
+            f"({self.ping_timeouts} over the {MESH_PING_TIMEOUT_MS:.0f} ms "
+            "timeout)",
+            f"Ping RTT ms: p50 {self.ping_ms_p50:.0f} "
+            f"p99 {self.ping_ms_p99:.0f}",
+        ])
+
+
+def discovery_graph(
+    kstate: kad.KadState, connect_to: int, bootstraps: np.ndarray,
+    seed: int,
+) -> ConnGraph:
+    """Sample each node's dials from its ROUTING TABLE (DHT-discovered peers,
+    kad_utils.nim:8-11) instead of the flagship's global shuffle. Nodes with
+    fewer than connect_to table entries dial what they have plus the anchors
+    (the reference's conns are likewise bootstrap-heavy early on)."""
+    rt = np.asarray(kstate.rtable)
+    n = rt.shape[0]
+    rng = np.random.default_rng(seed ^ 0x4E6)
+    dials = np.full((n, connect_to), -1, dtype=np.int64)
+    for p in range(n):
+        known = np.unique(rt[p][rt[p] >= 0])
+        known = known[known != p]
+        if len(known) >= connect_to:
+            dials[p] = rng.choice(known, size=connect_to, replace=False)
+        else:
+            pool = np.unique(np.concatenate([known, bootstraps]))
+            pool = pool[pool != p]
+            take = min(len(pool), connect_to)
+            dials[p, :take] = rng.choice(pool, size=take, replace=False)
+            if take < connect_to:  # pad with ring neighbors (never dial self)
+                pad = (p + 1 + np.arange(connect_to - take)) % n
+                dials[p, take:] = np.where(pad == p, (p + 1) % n, pad)
+    return build_connection_graph(n, connect_to, seed=seed, dials=dials)
+
+
+class RegressionSimulator:
+    """Discovery-then-dissemination composition: ops/kad forms the graph,
+    the standard Simulator runs GossipSub over it, plus mesh ping probes."""
+
+    def __init__(self, cfg: RegressionConfig):
+        import jax.numpy as jnp
+
+        cfg.validate()
+        self.cfg = cfg
+        n = cfg.network_size
+        topo = cfg.topo or TopoParams(
+            network_size=n, muxer=cfg.muxer, msg_size_bytes=cfg.msg_size,
+            num_frags=cfg.fragments, messages=cfg.messages,
+            delay_seconds=cfg.delay_seconds,
+        )
+        self.topo_params = topo
+        self.topology = Topology.build(topo)
+        self._stage = jnp.asarray(self.topology.stage_of_peer)
+        self._lat = jnp.asarray(self.topology.latency_ms)
+        self.kstate = kad.init_kad_state(n, seed=cfg.seed)
+        self.bootstraps = jnp.arange(cfg.n_bootstrap, dtype=jnp.int32)
+        self.lines: list[str] = []
+        self.pings: list[PingRecord] = []
+        self.sim: Simulator | None = None
+
+    def _log(self, line: str) -> None:
+        self.lines.append(line)
+
+    # ---------------------------------------------------------------- phases
+
+    def discover(self) -> None:
+        """STARTSLEEP -> connectToBootstrap -> seedBootstraps (updatePeers +
+        forceRefresh bootstrap round = one self-lookup wave) -> warmup
+        randoms (main.nim:223-232)."""
+        import jax
+        import jax.numpy as jnp
+
+        cfg = self.cfg
+        n = cfg.network_size
+        self.kstate = kad.seed_bootstraps(self.kstate, self.bootstraps)
+        self._log(f"kad-dht discovery active bootstraps={cfg.n_bootstrap}")
+        origins = jnp.arange(cfg.n_bootstrap, n, dtype=jnp.int32)
+        # forceRefresh bootstrap round: FIND_NODE(self)
+        _, self.kstate = kad.find_node(
+            self.kstate, origins, self.kstate.keys[origins],
+            self._stage, self._lat,
+        )
+        key = jax.random.PRNGKey(cfg.seed ^ 0x4E62)
+        for _ in range(cfg.discovery_rounds - 1):
+            key, k = jax.random.split(key)
+            _, self.kstate = kad.find_node(
+                self.kstate, origins, kad.random_targets(k, origins.shape[0]),
+                self._stage, self._lat,
+            )
+
+    def build_sim(self) -> Simulator:
+        cfg = self.cfg
+        graph = discovery_graph(
+            self.kstate, cfg.connect_to,
+            np.arange(cfg.n_bootstrap), cfg.seed,
+        )
+        exp = ExperimentConfig(
+            topo=self.topo_params,
+            connect_to=cfg.connect_to,
+            gossipsub=regression_gossipsub_params(),
+            publisher_id=cfg.n_bootstrap,      # first normal node publishes
+            warmup_s=cfg.start_sleep_s / 4.0,  # meshes stabilize post-dial
+            seed=cfg.seed,
+        )
+        sim = Simulator(exp, topology=self.topology)
+        # swap in the DHT-discovered graph (Simulator built a shuffle graph)
+        from ..ops.state import graph_arrays, init_state, SimParams
+
+        sim.graph = graph
+        sim.params = SimParams.from_gossipsub(
+            cfg.network_size, graph.capacity, regression_gossipsub_params(),
+        )
+        sim.state = init_state(sim.params, seed=cfg.seed)
+        sim.arrays = graph_arrays(graph)
+        self.sim = sim
+        return sim
+
+    def ping_round(self) -> None:
+        """One pingMeshLoop pass: ping every mesh peer (ping_utils.nim:84-87).
+        RTT = 2 x stage latency + dial/processing overhead."""
+        assert self.sim is not None
+        state = self.sim.state
+        mesh = np.asarray(state.mesh_mask)
+        conns = np.asarray(self.sim.graph.conns)
+        stage = np.asarray(self.topology.stage_of_peer)
+        lat = np.asarray(self.topology.latency_ms)
+        p_idx, s_idx = np.nonzero(mesh & (conns >= 0))
+        targets = conns[p_idx, s_idx]
+        rtt = 2.0 * lat[stage[p_idx], stage[targets]] + 2.0
+        for p, q, ms in zip(p_idx, targets, rtt):
+            self.pings.append(PingRecord(int(p), int(q), float(ms)))
+        # log a sample (the reference logs every ping; keep lines bounded)
+        for p, q, ms in list(zip(p_idx, targets, rtt))[:20]:
+            self._log(f"mesh ping peerId={q} pingMs={ms:.0f}")
+
+    def run(self) -> RegressionSummary:
+        cfg = self.cfg
+        self.discover()
+        sim = self.build_sim()
+        sim.warmup()
+        mesh_deg = float(np.asarray(
+            sim.state.mesh_mask.sum(axis=-1)).mean())
+        self._log(f"Mesh details meshSize={mesh_deg:.1f}")
+        for i in range(cfg.messages):
+            if i > 0:
+                sim.advance(cfg.delay_seconds * 1000.0)
+            sim.publish(cfg.n_bootstrap)
+        for _ in range(cfg.ping_rounds):
+            self.ping_round()
+            sim.advance(MESH_PING_INTERVAL_S * 1000.0)
+        return self.summary()
+
+    # --------------------------------------------------------------- outputs
+
+    def summary(self) -> RegressionSummary:
+        assert self.sim is not None
+        census = np.asarray(kad.rtable_census(self.kstate))
+        deg = np.asarray(self.sim.state.mesh_mask.sum(axis=-1))
+        recs = self.sim.records
+        n = self.cfg.network_size
+        cov = (np.mean([r.received.sum() / n for r in recs])
+               if recs else 0.0)
+        ping_ms = np.array([p.ping_ms for p in self.pings]) \
+            if self.pings else np.zeros(1)
+        return RegressionSummary(
+            census_mean=float(census.mean()),
+            mesh_degree_mean=float(deg.mean()),
+            coverage=float(cov),
+            ping_count=len(self.pings),
+            ping_ms_p50=float(np.percentile(ping_ms, 50)),
+            ping_ms_p99=float(np.percentile(ping_ms, 99)),
+            ping_timeouts=int((ping_ms > MESH_PING_TIMEOUT_MS).sum()),
+        )
+
+    def records(self) -> list[MessageRecord]:
+        return self.sim.records if self.sim else []
+
+
+def config_from_env() -> RegressionConfig:
+    """STARTSLEEP/FRAGMENTS/MUXER/NODE_ROLE surface (regression/env.nim)."""
+    return RegressionConfig(
+        network_size=env_int("PEERS", 100),
+        n_bootstrap=env_int("REGRESSION_BOOTSTRAPS", 1),
+        connect_to=env_int("CONNECTTO", 10),
+        start_sleep_s=float(env_int("STARTSLEEP", 180)),
+        muxer=env_str("MUXER", "yamux"),
+        fragments=env_int("FRAGMENTS", 1),
+        seed=env_int("SEED", 0),
+    )

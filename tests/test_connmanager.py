@@ -1,0 +1,139 @@
+"""Connection-manager workload tests (reference behavior:
+nim-test-node/connmanager/{main,env}.nim — watermark trimming, hard cap,
+protected peers, reconnect strategies)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dst_libp2p_test_node_tpu.ops.connmanager import (
+    RECONNECT_AGGRESSIVE,
+    RECONNECT_BEFORE_GRACE,
+    RECONNECT_NONE,
+    ConnManagerConfig,
+    ConnManagerParams,
+    config_from_env,
+    init_conn_state,
+    run_conn_steps,
+    run_connmanager,
+)
+
+
+def _run(params, mode, dial_out=None, protected=None, steps=30, seed=0):
+    m = params.n_peers
+    dial_out = np.ones(m, bool) if dial_out is None else dial_out
+    protected = np.zeros(m, bool) if protected is None else protected
+    state = init_conn_state(params, seed=seed)
+    state, trace = run_conn_steps(
+        state, jnp.asarray(np.asarray(mode, np.int32)), jnp.asarray(dial_out),
+        jnp.asarray(protected), params, steps,
+    )
+    return state, np.asarray(trace)
+
+
+def test_watermark_trims_to_low_water():
+    # 40 one-shot peers against high=20/low=10: the hub must trim to 10
+    params = ConnManagerParams(n_peers=40, low_water=10, high_water=20,
+                               silence_period_s=2)
+    state, trace = _run(params, np.full(40, RECONNECT_NONE))
+    assert trace.max() == 40          # all dials land before the first trim
+    assert trace[-1, 0] == 10         # trimmed down to lowWater
+    assert int(state.trims) == 30
+    # one-shot peers don't redial after being trimmed
+    assert int(state.dials) == 40
+
+
+def test_below_high_water_never_trims():
+    params = ConnManagerParams(n_peers=15, low_water=10, high_water=20)
+    state, trace = _run(params, np.full(15, RECONNECT_NONE))
+    assert int(state.trims) == 0
+    assert trace[-1, 0] == 15
+
+
+def test_protected_peers_survive_trim():
+    params = ConnManagerParams(n_peers=40, low_water=5, high_water=10)
+    protected = np.zeros(40, bool)
+    protected[:8] = True
+    state, trace = _run(params, np.full(40, RECONNECT_NONE),
+                        protected=protected)
+    conn = np.asarray(state.conn)[0]
+    assert conn[:8].all()             # protect() spares them (main.nim:59-60)
+    # trim target excludes protected: 5 low_water slots are filled by others
+    assert conn.sum() >= 8
+
+
+def test_grace_period_shields_fresh_connections():
+    # every connection stays younger than grace -> nothing is evictable
+    params = ConnManagerParams(n_peers=30, low_water=5, high_water=10,
+                               grace_period_s=3600)
+    state, trace = _run(params, np.full(30, RECONNECT_NONE))
+    assert int(state.trims) == 0
+    assert trace[-1, 0] == 30
+
+
+def test_aggressive_reconnect_oscillates():
+    # aggressive peers redial within a second of being trimmed: the count
+    # oscillates between low_water and above high_water (run B behavior)
+    params = ConnManagerParams(n_peers=30, low_water=10, high_water=20,
+                               silence_period_s=2)
+    state, trace = _run(params, np.full(30, RECONNECT_AGGRESSIVE), steps=60)
+    t = trace[:, 0]
+    assert int(state.trims) > 30      # trims keep happening
+    assert t.max() == 30 and t.min() <= params.low_water + 1
+    # it recovers after every trim
+    assert (t[-10:] >= params.low_water).all()
+    assert int(state.dials) > 40
+
+
+def test_before_grace_cycling_abuses_grace_window():
+    # cyclers reconnect every interval and stay inside the grace window, so
+    # the watermark can never evict them ("grace abuse", main.nim:132)
+    params = ConnManagerParams(n_peers=30, low_water=5, high_water=10,
+                               grace_period_s=30, reconnect_interval_s=10,
+                               silence_period_s=2)
+    state, trace = _run(params, np.full(30, RECONNECT_BEFORE_GRACE), steps=40)
+    assert int(state.cycles) > 0      # cycle disconnects happened
+    assert int(state.trims) == 0      # grace shields every connection
+    assert int(state.dials) > 30      # re-dials after each cycle
+
+
+def test_hard_cap_rejects_dials():
+    params = ConnManagerParams(n_peers=50, low_water=10, high_water=20,
+                               max_connections=25)
+    state, trace = _run(params, np.full(50, RECONNECT_NONE))
+    assert trace.max() <= 25          # semaphore cap (main.nim:54-55)
+    assert int(state.rejected) > 0
+
+
+def test_multi_hub_mesh_and_experiment_summary():
+    cfg = ConnManagerConfig(
+        params=ConnManagerParams(n_hubs=3, n_peers=24, low_water=6,
+                                 high_water=12),
+        n_none=12, n_aggressive=6, n_before_grace=6,
+        duration_s=40,
+    )
+    summary, state = run_connmanager(cfg)
+    # hub-to-hub full mesh stays up (main.nim:80-91)
+    hub_conn = np.asarray(state.hub_conn)
+    assert (hub_conn == ~np.eye(3, dtype=bool)).all()
+    assert summary.trace.shape == (40, 3)
+    assert summary.trims > 0
+    assert "Watermark trims" in summary.report()
+
+
+def test_config_from_env(monkeypatch):
+    monkeypatch.setenv("WATERMARK_LOW", "7")
+    monkeypatch.setenv("WATERMARK_HIGH", "14")
+    monkeypatch.setenv("WATERMARK_GRACE_PERIOD_S", "5")
+    monkeypatch.setenv("MAX_CONNECTIONS", "99")
+    monkeypatch.setenv("NUM_HUBS", "2")
+    monkeypatch.setenv("PROTECTED_PEERS", "a, b ,c")
+    monkeypatch.setenv("RECONNECT_INTERVAL_S", "31")
+    cfg = config_from_env()
+    p = cfg.params
+    assert (p.low_water, p.high_water, p.grace_period_s) == (7, 14, 5)
+    assert p.max_connections == 99 and p.n_hubs == 2
+    assert cfg.n_protected == 3
+    assert p.reconnect_interval_s == 31
+    with pytest.raises(ValueError):
+        ConnManagerParams(low_water=5, high_water=4).validate()

@@ -1,0 +1,95 @@
+"""Regression-node tests (reference behavior: nim-test-node/regression —
+GossipSub mesh formed via kad-dht discovery, mesh-peer ping probes).
+
+One shared simulation run (module fixture) keeps the jit compile chain to a
+single network size; the assertions slice it from different angles."""
+
+import numpy as np
+import pytest
+
+from dst_libp2p_test_node_tpu.ops import kad
+from dst_libp2p_test_node_tpu.runtime.regression_runtime import (
+    MESH_PING_TIMEOUT_MS,
+    RegressionConfig,
+    RegressionSimulator,
+    config_from_env,
+    discovery_graph,
+    regression_gossipsub_params,
+)
+
+N = 48
+
+
+@pytest.fixture(scope="module")
+def run():
+    cfg = RegressionConfig(network_size=N, n_bootstrap=1, connect_to=6,
+                           messages=2, msg_size=500, ping_rounds=1,
+                           discovery_rounds=2, seed=0)
+    sim = RegressionSimulator(cfg)
+    summary = sim.run()
+    return sim, summary
+
+
+def test_regression_gossipsub_params():
+    """The regression node pins dScore=6, dOut=3 (main.nim:141-152), unlike
+    the flagship's env-tunable dScore=4."""
+    g = regression_gossipsub_params()
+    assert (g.d, g.d_low, g.d_high) == (6, 4, 8)
+    assert g.d_score == 6 and g.d_out == 3
+
+
+def test_discovery_graph_uses_routing_tables(run):
+    sim, _ = run
+    graph = discovery_graph(sim.kstate, 6, np.array([0]), seed=0)
+    graph.validate()
+    conns = graph.conns
+    for p in range(N):
+        nbrs = conns[p][conns[p] >= 0]
+        assert p not in nbrs
+        assert len(set(nbrs.tolist())) == len(nbrs)
+    # the anchor is massively popular (everyone learns it at seeding)
+    assert (conns == 0).sum() >= 6
+
+
+def test_regression_end_to_end(run):
+    sim, s = run
+    assert s.coverage > 0.95            # DHT-discovered mesh disseminates
+    assert s.census_mean > 5.0
+    assert 3.0 <= s.mesh_degree_mean <= 8.5   # D bounds (dLow..dHigh)
+    assert s.ping_count > 0
+    assert s.ping_ms_p50 > 0
+    assert s.ping_timeouts == 0
+    text = "\n".join(sim.lines)
+    assert "kad-dht discovery active" in text
+    assert "Mesh details" in text
+    assert "mesh ping peerId=" in text
+    # latency lines flow through the standard record path
+    recs = sim.records()
+    assert len(recs) == 2
+    assert all(r.delays_ms_int.size > 0 for r in recs)
+    assert "Regression summary" in s.report()
+
+
+def test_ping_rtt_matches_topology(run):
+    sim, _ = run
+    lat = sim.topology.latency_ms
+    stage = sim.topology.stage_of_peer
+    assert sim.pings
+    for p in sim.pings[:50]:
+        want = 2.0 * lat[stage[p.peer], stage[p.target]] + 2.0
+        assert p.ping_ms == pytest.approx(want)
+        assert p.ping_ms < MESH_PING_TIMEOUT_MS
+
+
+def test_config_from_env(monkeypatch):
+    monkeypatch.setenv("PEERS", "80")
+    monkeypatch.setenv("STARTSLEEP", "60")
+    monkeypatch.setenv("FRAGMENTS", "2")
+    monkeypatch.setenv("CONNECTTO", "7")
+    cfg = config_from_env()
+    assert cfg.network_size == 80
+    assert cfg.start_sleep_s == 60.0
+    assert cfg.fragments == 2
+    assert cfg.connect_to == 7
+    with pytest.raises(ValueError):
+        RegressionConfig(network_size=10, connect_to=10).validate()

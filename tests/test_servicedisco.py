@@ -1,0 +1,222 @@
+"""Service-discovery tests (reference behavior:
+nim-test-node/service-discovery/{core,env}.nim — advertise/lookup over the
+DHT, TTL expiry, safety/ip-sim placement, env parser rigor)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dst_libp2p_test_node_tpu.ops import kad
+from dst_libp2p_test_node_tpu.ops.servicedisco import (
+    SDParams,
+    advertise,
+    expire_sweep,
+    init_advert_store,
+    lookup,
+    service_key,
+)
+from dst_libp2p_test_node_tpu.runtime.sd_runtime import (
+    SDConfig,
+    SDSimulator,
+    config_from_env,
+)
+
+
+def _fully_informed(n, seed=0):
+    st = kad.init_kad_state(n, seed=seed)
+    allp = jnp.arange(n, dtype=jnp.int32)
+    st = kad.rtable_insert(st, allp, jnp.broadcast_to(allp[None, :], (n, n)))
+    stage = jnp.zeros((n,), jnp.int32)
+    lat = jnp.full((2, 2), 50.0, jnp.float32)
+    return st, stage, lat
+
+
+def test_service_key_stable_and_distinct():
+    a = service_key("svc-a")
+    assert (a == service_key("svc-a")).all()
+    assert (a != service_key("svc-b")).any()
+    assert a.shape == (kad.KEY_WORDS,) and a.dtype == np.uint32
+
+
+def test_advertise_places_records_at_closest_nodes():
+    n = 48
+    st, stage, lat = _fully_informed(n)
+    store = init_advert_store(n)
+    svc_keys = jnp.asarray(np.stack([service_key("svc-a")]))
+    advs = jnp.asarray([5, 6, 7], jnp.int32)
+    svc = jnp.zeros((3,), jnp.int32)
+    seq = jnp.zeros((3,), jnp.int32)
+    params = SDParams(k_store=4)
+    store, st, wave_ms = advertise(
+        store, st, advs, svc, svc_keys, seq, stage, lat,
+        jnp.float32(0.0), params,
+    )
+    prov = np.asarray(store.provider)
+    assert set(np.unique(prov[prov >= 0])) == {5, 6, 7}
+    # records live on the k_store globally closest nodes to the service key
+    truth = set(kad.true_closest(np.asarray(st.keys),
+                                 np.asarray(svc_keys[0]), 4).tolist())
+    rows_with_records = set(np.nonzero((prov >= 0).any(axis=1))[0].tolist())
+    assert rows_with_records == truth
+    assert (np.asarray(wave_ms) > 0).all()
+
+
+def test_lookup_finds_providers_and_dedups():
+    n = 48
+    st, stage, lat = _fully_informed(n, seed=1)
+    store = init_advert_store(n)
+    svc_keys = jnp.asarray(np.stack([service_key("svc-a"),
+                                     service_key("svc-b")]))
+    advs = jnp.asarray([5, 6, 7, 8], jnp.int32)
+    svc = jnp.asarray([0, 0, 1, 1], jnp.int32)
+    seq = jnp.zeros((4,), jnp.int32)
+    params = SDParams(k_store=4)
+    store, st, _ = advertise(store, st, advs, svc, svc_keys, seq, stage, lat,
+                             jnp.float32(0.0), params)
+    dis = jnp.asarray([20, 21], jnp.int32)
+    dsvc = jnp.asarray([0, 1], jnp.int32)
+    res, st = lookup(store, st, dis, dsvc, svc_keys, stage, lat,
+                     jnp.float32(1000.0), params)
+    uniq = np.asarray(res.unique_peers)
+    ads = np.asarray(res.advertisements)
+    assert uniq.tolist() == [2, 2]           # svc-a: {5,6}; svc-b: {7,8}
+    assert (ads >= uniq).all()               # replica copies >= providers
+    assert (np.asarray(res.latency_ms) > 0).all()
+
+
+def test_advert_expiry():
+    n = 32
+    st, stage, lat = _fully_informed(n, seed=2)
+    store = init_advert_store(n)
+    svc_keys = jnp.asarray(np.stack([service_key("svc-a")]))
+    advs = jnp.asarray([3], jnp.int32)
+    params = SDParams(k_store=4, advert_expiry_ms=10_000.0)
+    store, st, _ = advertise(
+        store, st, advs, jnp.zeros((1,), jnp.int32), svc_keys,
+        jnp.zeros((1,), jnp.int32), stage, lat, jnp.float32(0.0), params,
+    )
+    dis = jnp.asarray([10], jnp.int32)
+    dsvc = jnp.zeros((1,), jnp.int32)
+    res, st = lookup(store, st, dis, dsvc, svc_keys, stage, lat,
+                     jnp.float32(5000.0), params)
+    assert int(res.unique_peers[0]) == 1     # alive before expiry
+    res, st = lookup(store, st, dis, dsvc, svc_keys, stage, lat,
+                     jnp.float32(20_000.0), params)
+    assert int(res.unique_peers[0]) == 0     # expired after TTL
+    # expire_sweep reclaims the slots
+    store = expire_sweep(store, jnp.float32(20_000.0))
+    assert (np.asarray(store.provider) == -1).all()
+
+
+def test_readvertise_refreshes_in_place():
+    n = 32
+    st, stage, lat = _fully_informed(n, seed=3)
+    store = init_advert_store(n)
+    svc_keys = jnp.asarray(np.stack([service_key("svc-a")]))
+    advs = jnp.asarray([3], jnp.int32)
+    svc0 = jnp.zeros((1,), jnp.int32)
+    params = SDParams(k_store=4)
+    store, st, _ = advertise(store, st, advs, svc0, svc_keys,
+                             jnp.asarray([0], jnp.int32), stage, lat,
+                             jnp.float32(0.0), params)
+    n_slots0 = int((np.asarray(store.provider) >= 0).sum())
+    store, st, _ = advertise(store, st, advs, svc0, svc_keys,
+                             jnp.asarray([1], jnp.int32), stage, lat,
+                             jnp.float32(1000.0), params)
+    # same (provider, service): refresh, not duplicate
+    assert int((np.asarray(store.provider) >= 0).sum()) == n_slots0
+    assert np.asarray(store.seq_no).max() == 1
+    assert np.asarray(store.expires_ms).max() > 900_000.0
+
+
+def test_safety_param_widens_replication():
+    assert SDParams(k_store=8, safety_param=0.0).replication == 8
+    assert SDParams(k_store=8, safety_param=0.5).replication == 12
+    assert SDParams(k_store=8, safety_param=0.5).ad_bytes == 256
+    assert SDParams(xpr_publishing=False).ad_bytes == 64
+
+
+def test_ip_sim_coefficient_spreads_replicas_across_stages():
+    n = 48
+    st, _, _ = _fully_informed(n, seed=4)
+    # two stages; advertiser in stage 0
+    stage = jnp.asarray((np.arange(n) % 2).astype(np.int32))
+    lat = jnp.full((3, 3), 50.0, jnp.float32)
+    store = init_advert_store(n)
+    svc_keys = jnp.asarray(np.stack([service_key("svc-a")]))
+    advs = jnp.asarray([0], jnp.int32)  # stage 0
+    params_spread = SDParams(k_store=4, ip_sim_coefficient=10.0)
+    store, st2, _ = advertise(
+        store, st, advs, jnp.zeros((1,), jnp.int32), svc_keys,
+        jnp.zeros((1,), jnp.int32), stage, lat, jnp.float32(0.0),
+        params_spread,
+    )
+    holders = np.nonzero((np.asarray(store.provider) >= 0).any(axis=1))[0]
+    # with a strong demotion every replica avoids the advertiser's stage
+    assert (np.asarray(stage)[holders] == 1).all()
+
+
+def test_sd_simulator_end_to_end():
+    cfg = SDConfig(network_size=40, n_bootstrap=2, n_advertisers=4,
+                   n_discoverers=4, services=["svc-a"],
+                   lookup_interval_s=10, duration_s=20, seed=0)
+    sim = SDSimulator(cfg)
+    s = sim.run()
+    text = "\n".join(sim.lines)
+    assert "Advertising service service=svc-a" in text
+    assert "Lookup completed service=svc-a" in text
+    assert s.lookups == 2 * 4                # 2 ticks x 4 discoverers
+    assert s.lookups_nonempty == s.lookups   # DHT finds the records
+    assert s.unique_peers_max <= s.expected_providers
+    assert s.unique_peers_mean >= 1.0
+    assert "Service-discovery summary" in s.report()
+
+
+def test_config_from_env_validation(monkeypatch):
+    monkeypatch.setenv("ADVERTISE_SERVICES", "a, b ,")
+    monkeypatch.setenv("LOOKUP_INTERVAL_SECONDS", "7")
+    monkeypatch.setenv("SD_SAFETY_PARAM", "0.25")
+    monkeypatch.setenv("SD_XPR_PUBLISHING", "no")
+    cfg = config_from_env()
+    assert cfg.services == ["a", "b"]
+    assert cfg.lookup_interval_s == 7
+    assert cfg.sd.safety_param == 0.25
+    assert cfg.sd.xpr_publishing is False
+
+    monkeypatch.setenv("LOOKUP_INTERVAL_SECONDS", "0")
+    with pytest.raises(ValueError):
+        config_from_env()
+    monkeypatch.setenv("LOOKUP_INTERVAL_SECONDS", "7")
+    monkeypatch.setenv("SD_SAFETY_PARAM", "-1")
+    with pytest.raises(ValueError):
+        config_from_env()
+
+
+def test_discover_services_independent_of_advertised(monkeypatch):
+    monkeypatch.setenv("ADVERTISE_SERVICES", "svc-a")
+    monkeypatch.setenv("DISCOVER_SERVICES", "svc-b")
+    monkeypatch.delenv("SD_SAFETY_PARAM", raising=False)
+    monkeypatch.delenv("LOOKUP_INTERVAL_SECONDS", raising=False)
+    cfg = config_from_env()
+    assert cfg.services == ["svc-a"]
+    assert cfg.discover_services == ["svc-b"]
+    cfg.network_size = 40
+    cfg.n_advertisers = 3
+    cfg.n_discoverers = 3
+    cfg.n_hybrid = 0
+    cfg.duration_s = 16
+    cfg.lookup_interval_s = 15
+    sim = SDSimulator(cfg)
+    s = sim.run()
+    # discoverers query svc-b, which nobody advertises -> zero providers
+    assert all("service=svc-b" in ln for ln in sim.lines
+               if "Lookup completed" in ln)
+    assert s.unique_peers_max == 0
+
+
+def test_replication_wider_than_k_resp_rejected():
+    from dst_libp2p_test_node_tpu.ops.servicedisco import SDParams
+
+    cfg = SDConfig(sd=SDParams(k_store=8, safety_param=1.5))
+    with pytest.raises(ValueError, match="K_RESP"):
+        cfg.validate()
