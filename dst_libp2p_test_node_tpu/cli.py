@@ -152,10 +152,26 @@ def cmd_run(argv: list[str]) -> int:
     p.add_argument("--no-gossip", action="store_true")
     p.add_argument("--churn", type=float, default=0.0,
                    help="per-heartbeat down-probability (failure injection)")
+    p.add_argument("--use-mix", action="store_true",
+                   help="route publishes through the mix network (USESMIX)")
+    p.add_argument("--num-mix", type=int, default=0, help="NUMMIX")
+    p.add_argument("--mix-d", type=int, default=4, help="MIXD")
     p.add_argument("--out-prefix", default="")
     p.add_argument("--stats-json", action="store_true",
                    help="also write stats<i>.json next to latencies<i>")
     a = p.parse_args(argv)
+    if a.use_mix:
+        # a publisher that is itself a mix node is excluded from its own
+        # relay path, so rotation (any ordinal publishes) or a mix-range
+        # publisher_id needs one spare node
+        need = a.mix_d + (
+            1 if (int(a.publisher_rotation) or int(a.publisher_id) < a.num_mix)
+            else 0
+        )
+        if a.num_mix < need:
+            p.error(f"--use-mix requires --num-mix >= {need} here "
+                    f"(mix-d={a.mix_d}, publisher inside mix range or "
+                    f"rotation on), got {a.num_mix}")
 
     from .runtime.simulator import ExperimentConfig, Simulator
     from .runtime.summarize import report
@@ -179,15 +195,20 @@ def cmd_run(argv: list[str]) -> int:
             with_gossip=not a.no_gossip,
             churn_down_per_hb=a.churn,
             churn_up_per_hb=a.churn / 2 if a.churn else 0.0,
+            uses_mix=a.use_mix,
+            num_mix=a.num_mix,
+            mix_d=a.mix_d,
         )
         t0 = time.time()
         sim = Simulator(cfg, topology=t)
         sim.run()
         wall = time.time() - t0
         n_lines = sim.write_latencies(f"{a.out_prefix}latencies{i}")
+        sim.write_shadowlog(f"{a.out_prefix}shadowlog{i}")  # run.sh:60 artifact
         s = sim.summary(large)
         print(f"Summary for turn {i}")
         print(report(s, large=large), end="")
+        print(sim.bandwidth_report(), end="")  # summary_shadowlog.awk (run.sh:70-74)
         print(
             f"[tpu backend] wall={wall:.2f}s "
             f"peers*rounds/s={sim.peer_rounds_per_sec(wall):.0f} "
@@ -247,6 +268,9 @@ def cmd_serve(argv: list[str]) -> int:
         warmup_s=a.warmup_s,
         self_trigger=node.self_trigger,
         max_connections=node.max_connections,
+        uses_mix=node.uses_mix,
+        num_mix=node.num_mix,
+        mix_d=node.mix_d,
     )
     sim = Simulator(cfg)
     sim.warmup()

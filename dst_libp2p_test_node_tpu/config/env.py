@@ -209,6 +209,15 @@ class NodeConfig:
                 "Not enough peers to make target connections. Network size : "
                 f"{self.network_size}"
             )
+        if self.uses_mix and self.num_mix < self.mix_d + 1:
+            # fail fast on the surface BASELINE config 5 depends on, rather
+            # than silently running without anonymity. The +1: any peer may
+            # publish via /publish, and a mix-node publisher is excluded
+            # from its own relay path
+            raise ValueError(
+                f"USESMIX requires NUMMIX >= MIXD + 1, got "
+                f"NUMMIX={self.num_mix} MIXD={self.mix_d}"
+            )
         self.gossipsub.validate()
 
     @property

@@ -1,0 +1,132 @@
+"""Mix-routing (anonymity relay) layer — the MOUNTSMIX/USESMIX surface.
+
+The reference README documents a mix protocol for nim-libp2p nodes
+(README.md:30,42-46: MOUNTSMIX, USESMIX, NUMMIX, MIXD, FILEPATH) whose
+implementation is absent from the snapshot (SURVEY.md §5: only the parsed
+`filePath` remains, gossipsub-queues/env.nim:22). BASELINE config 5
+("1M-peer mix-routed, MOUNTSMIX, MIXD=4") requires it, so this module
+implements the documented semantics from first principles:
+
+  a publisher that *uses* the mix network (USESMIX) does not publish
+  directly; it wraps the message in MIXD layers (Sphinx-style onion) and
+  sends it through MIXD distinct mix nodes drawn from the NUMMIX peers that
+  *mount* the protocol (MOUNTSMIX). The final mix node — the exit — injects
+  the message into GossipSub. Receivers still measure latency against the
+  timestamp the *origin* embedded, so the mix path delay (per-hop link
+  latency + uplink serialization of the padded packet + per-hop unwrap
+  processing) is part of the measured dissemination latency.
+
+TPU shape: path sampling is a masked top-k over one uniform draw (no
+Python loops, no rejection sampling); per-hop delays are two gathers into
+the stage-latency matrix; everything jits and vmaps over simultaneous
+publishes. Mix-node assignment is deterministic from peer ordinals
+(ids [0, NUMMIX)), mirroring the reference's hostname-ordinal role
+convention (kad-dht/env.nim:27-28 assigns roles by ordinal the same way).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+# Sphinx packets are fixed-size regardless of payload (that is the point of
+# the format: unlinkability). 2413 B is the classic Sphinx packet size used
+# by mixnet implementations; messages larger than the packet body would
+# fragment, which we model as ceil(payload / body) serialized packets.
+SPHINX_PACKET_BYTES = 2413
+SPHINX_BODY_BYTES = 2048
+
+
+@dataclass(frozen=True)
+class MixParams:
+    """Static mix-network parameters (hashable -> jit static arg)."""
+
+    num_mix: int            # NUMMIX — peers [0, num_mix) mount the protocol
+    mix_d: int = 4          # MIXD — hops to traverse
+    proc_delay_ms: float = 5.0   # per-hop Sphinx unwrap + re-forward cost
+    packet_bytes: int = SPHINX_PACKET_BYTES
+    body_bytes: int = SPHINX_BODY_BYTES
+
+    def validate(self) -> None:
+        if self.mix_d < 1:
+            raise ValueError("MIXD must be >= 1")
+        if self.num_mix < self.mix_d:
+            raise ValueError(
+                f"need NUMMIX >= MIXD distinct mix nodes, got "
+                f"{self.num_mix} < {self.mix_d}"
+            )
+
+
+def mix_node_mask(n: int, num_mix: int) -> jnp.ndarray:
+    """(N,) bool — which peers mount the mix protocol (ordinal rule)."""
+    return jnp.arange(n) < num_mix
+
+
+def eligible_mix_count(alive, publisher: int, n: int, num_mix: int) -> int:
+    """How many mix nodes can actually relay for this publisher right now
+    (mounted, alive, and not the publisher itself). Callers must check this
+    is >= mix_d before mix_route — the jitted sampler cannot raise."""
+    import numpy as np
+
+    m = np.asarray(mix_node_mask(n, num_mix)) & np.asarray(alive)
+    if publisher < num_mix:
+        m = m.copy()
+        m[publisher] = False
+    return int(m.sum())
+
+
+@partial(jax.jit, static_argnames=("params", "n"))
+def mix_route(
+    key: jnp.ndarray,
+    publisher,
+    alive: jnp.ndarray,          # (N,) bool churn mask
+    stage: jnp.ndarray,          # (N,) int32 topology stage per peer
+    lat_ms: jnp.ndarray,         # (S, S) stage-pair latency
+    bw_up_mbit_per_stage: jnp.ndarray,  # (S,)
+    params: MixParams,
+    n: int,
+    payload_bytes,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Sample a MIXD-hop path and price it.
+
+    Returns (path, exit_node, path_delay_ms): the MIXD relay peer ids, the
+    peer that will publish into GossipSub on the origin's behalf, and the
+    elapsed time between the origin's send and the exit node being ready to
+    publish. Dead mix nodes (churn) are excluded from the draw; the
+    publisher never relays its own packet. Sampling MIXD distinct nodes =
+    top-MIXD of one uniform vector masked to eligible mix nodes — an
+    argsort, not a loop. Precondition (host-checked via
+    eligible_mix_count): at least mix_d eligible nodes, else the path tail
+    would silently pick up ineligible peers.
+    """
+    mix_ok = mix_node_mask(n, params.num_mix) & alive
+    mix_ok = mix_ok & (jnp.arange(n) != publisher)
+    u = jax.random.uniform(key, (n,))
+    # ineligible nodes sort last; caller guarantees >= mix_d eligible
+    order = jnp.argsort(jnp.where(mix_ok, u, 2.0))
+    path = order[: params.mix_d]                        # (MIXD,) peer ids
+
+    # hop endpoints: origin -> m1 -> ... -> m_MIXD (exit)
+    hops_from = jnp.concatenate([jnp.asarray([publisher]), path[:-1]])
+    hops_to = path
+    hop_lat = lat_ms[stage[hops_from], stage[hops_to]]  # (MIXD,)
+
+    # each hop serializes ceil(payload/body) fixed-size packets on the
+    # sender's uplink, then pays the unwrap cost at the receiver.
+    # payload_bytes stays a traced value: /publish takes msgSize per request
+    # (runtime/node_service.py), so baking it static would recompile the
+    # publish hot path for every distinct size
+    n_packets = jnp.ceil(jnp.asarray(payload_bytes, jnp.float32) / params.body_bytes)
+    wire_bytes = n_packets * params.packet_bytes
+    tx_ms = (wire_bytes * 8.0) / (bw_up_mbit_per_stage[stage[hops_from]] * 1e6) * 1e3
+    delay = jnp.sum(hop_lat + tx_ms) + params.mix_d * params.proc_delay_ms
+    return path, path[-1], delay.astype(jnp.float32)
+
+
+def mix_wire_bytes(params: MixParams, payload_bytes: int) -> int:
+    """Bytes each mix hop puts on the wire for one message (padding incl.)."""
+    n_packets = -(-payload_bytes // params.body_bytes)
+    return n_packets * params.packet_bytes

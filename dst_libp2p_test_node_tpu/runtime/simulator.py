@@ -55,6 +55,12 @@ class ExperimentConfig:
     with_gossip: bool = True
     churn_down_per_hb: float = 0.0
     churn_up_per_hb: float = 0.0
+    # Mix-routing surface (README.md:42-46; BASELINE config 5). When
+    # uses_mix is set, every publish relays through mix_d of the num_mix
+    # mix-mounting peers before entering GossipSub (ops/mix.py).
+    uses_mix: bool = False
+    num_mix: int = 0
+    mix_d: int = 4
 
 
 @dataclass
@@ -112,6 +118,12 @@ class Simulator:
         self._msg_rng = np.random.default_rng(cfg.seed ^ 0x6D736749)  # msgId stream
         self._hb_carry_ms = 0.0
         self.records: list[MessageRecord] = []
+        self.mix_params = None
+        if cfg.uses_mix:
+            from ..ops.mix import MixParams
+
+            self.mix_params = MixParams(num_mix=cfg.num_mix, mix_d=cfg.mix_d)
+            self.mix_params.validate()
 
     # ---------------------------------------------------------------- phases
 
@@ -135,6 +147,52 @@ class Simulator:
         cfg = self.cfg
         size = msg_size if msg_size is not None else cfg.topo.msg_size_bytes
         a = self.arrays
+        t0_ms = float(self.state.t_ms) + self._hb_carry_ms
+        origin = publisher
+        mix_delay = 0.0
+        if self.mix_params is not None:
+            # relay through the mix network first; the exit node publishes
+            # on the origin's behalf (ops/mix.py, README.md:42-46)
+            import jax
+            import jax.numpy as jnp
+
+            from ..ops.mix import eligible_mix_count, mix_route, mix_wire_bytes
+
+            eligible = eligible_mix_count(
+                np.asarray(self.state.alive), publisher,
+                self.params.n, self.mix_params.num_mix,
+            )
+            if eligible < self.mix_params.mix_d:
+                raise RuntimeError(
+                    f"mix network degraded: {eligible} eligible mix nodes "
+                    f"(alive, mounted, != publisher) < MIXD={self.mix_params.mix_d}"
+                )
+            key, k_mix = jax.random.split(self.state.key)
+            path, exit_node, path_delay = mix_route(
+                k_mix,
+                publisher,
+                self.state.alive,
+                self._stage,
+                self._lat,
+                self._bw,
+                params=self.mix_params,
+                n=self.params.n,
+                payload_bytes=size,
+            )
+            mix_delay = float(path_delay)
+            wire = float(mix_wire_bytes(self.mix_params, size))
+            # per-hop attribution, both directions (Shadow's counters see
+            # both ends of every packet): senders are origin + first
+            # mix_d-1 relays, receivers are the mix_d relays
+            senders = jnp.concatenate(
+                [jnp.asarray([origin]), path[:-1]]
+            )
+            bytes_tx = self.state.bytes_tx.at[senders].add(wire)
+            bytes_rx = self.state.bytes_rx.at[path].add(wire)
+            self.state = self.state.replace(
+                key=key, bytes_tx=bytes_tx, bytes_rx=bytes_rx
+            )
+            publisher = int(exit_node)
         res, self.state = disseminate(
             self.state,
             a["conns"],
@@ -143,21 +201,21 @@ class Simulator:
             self._lat,
             self._bw,
             publisher=publisher,
-            t0_ms=float(self.state.t_ms) + self._hb_carry_ms,
+            t0_ms=t0_ms + mix_delay,
             params=self.params,
             payload_bytes=size,
             fragments=cfg.topo.num_frags,
             with_gossip=cfg.with_gossip,
         )
-        delays = np.asarray(res.delay_ms, dtype=np.float64)
+        delays = np.asarray(res.delay_ms, dtype=np.float64) + mix_delay
         received = np.asarray(res.received).copy()
         if not cfg.self_trigger:
-            received[publisher] = False  # publisher doesn't log its own message
+            received[origin] = False  # publisher doesn't log its own message
         delays = np.where(received, delays, np.inf)
         rec = MessageRecord(
             msg_id=int(self._msg_rng.integers(0, 2**63, dtype=np.int64)),
-            publisher=publisher,
-            t0_ms=float(self.state.t_ms) + self._hb_carry_ms,
+            publisher=origin,
+            t0_ms=t0_ms,
             delays_ms=delays,
             received=received,
             sends=np.asarray(res.sends),
@@ -207,6 +265,33 @@ class Simulator:
     def summary_report(self) -> str:
         large = self.cfg.topo.msg_size_bytes >= 1000
         return report(self.summary(large), large=large)
+
+    def traffic(self):
+        """Cumulative per-peer traffic counters (runtime/bandwidth.py)."""
+        from .bandwidth import PeerTraffic
+
+        return PeerTraffic.from_state(
+            self.state,
+            ihave_total=int(self.state.ihave_tx),
+            iwant_total=int(self.state.iwant_tx),
+        )
+
+    def write_shadowlog(self, path: str) -> int:
+        """Write Shadow-heartbeat-shaped '[node]' lines: the input of
+        summary_shadowlog.awk (run.sh:70-74)."""
+        from .bandwidth import shadowlog_lines
+
+        lines = shadowlog_lines(self.traffic())
+        with open(path, "w") as f:
+            for ln in lines:
+                f.write(ln + "\n")
+        return len(lines)
+
+    def bandwidth_report(self) -> str:
+        from .bandwidth import report as bw_report
+        from .bandwidth import summarize_bandwidth
+
+        return bw_report(summarize_bandwidth(self.traffic()))
 
     # ------------------------------------------------------------ statistics
 
