@@ -14,6 +14,9 @@ Subcommands:
                msg_size < 1000, run.sh:68-72).
   summarize  — re-run the summary over an existing latencies file.
   serve      — long-lived node service (HTTP /publish + /health, Prometheus).
+  inject     — publisher controller: POST /publish to node services at a
+               fixed inter-message delay (pod-api-requester / traffic_sync.py
+               analog, shadow/Dockerfile:45-53, topogen.py:124-136).
   kad        — role-based kad-dht workload (bootstrap/normal/probe).
   connmanager — hub-and-spoke watermark/reconnect stress workload.
   servicedisco — advertise/lookup service discovery over the DHT.
@@ -436,6 +439,32 @@ def cmd_servicedisco(argv: list[str]) -> int:
     return 0
 
 
+def cmd_inject(argv: list[str]) -> int:
+    """Publisher controller against running `serve` nodes — the traffic_sync
+    surface (-s size, -m messages, -d delay, --peer-selection id|rotation)."""
+    p = argparse.ArgumentParser(prog="inject")
+    p.add_argument("targets", nargs="+",
+                   help="node control endpoints (host[:port] or URL)")
+    p.add_argument("-s", "--msg-size", type=int, default=1500)
+    p.add_argument("-m", "--messages", type=int, default=10)
+    p.add_argument("-d", "--delay-s", type=float, default=1.0)
+    p.add_argument("--topic", default="test")
+    p.add_argument("--peer-selection", choices=["id", "rotation"], default="id")
+    p.add_argument("--publisher-id", type=int, default=0)
+    a = p.parse_args(argv)
+
+    from .runtime.publisher import inject
+
+    res = inject(
+        a.targets, a.msg_size, a.messages, a.delay_s, topic=a.topic,
+        peer_selection=a.peer_selection, publisher_id=a.publisher_id,
+    )
+    for r in res.replies:
+        print(json.dumps(r))
+    print(f"published ok={res.ok} failed={res.failed}")
+    return 0 if res.failed == 0 else 1
+
+
 def cmd_summarize(argv: list[str]) -> int:
     p = argparse.ArgumentParser(prog="summarize")
     p.add_argument("path")
@@ -469,6 +498,8 @@ def main(argv: list[str] | None = None) -> int:
         return cmd_summarize(rest)
     if cmd == "serve":
         return cmd_serve(rest)
+    if cmd == "inject":
+        return cmd_inject(rest)
     if cmd == "kad":
         return cmd_kad(rest)
     if cmd == "connmanager":

@@ -45,6 +45,11 @@ import jax
 import jax.numpy as jnp
 from flax import struct
 
+from ..parallel.exchange import (
+    build_recv_constants,
+    converge_recv,
+    converge_sharded,
+)
 from .state import SimParams, SimState
 
 INF = jnp.float32(3.4e38)
@@ -73,7 +78,8 @@ def _next_heartbeat(t, phase, hb_ms):
 
 @partial(
     jax.jit,
-    static_argnames=("params", "payload_bytes", "fragments", "with_gossip"),
+    static_argnames=("params", "payload_bytes", "fragments", "with_gossip",
+                     "mesh"),
 )
 def disseminate(
     state: SimState,
@@ -88,11 +94,19 @@ def disseminate(
     payload_bytes: int,
     fragments: int = 1,
     with_gossip: bool = True,
+    mesh=None,
 ):
     """Propagate one application message (all fragments) through the mesh.
 
     Returns (DisseminationResult, new_state). new_state carries advanced RNG,
     firstMessageDeliveries credit, and byte/duplicate counters.
+
+    The fixpoint itself runs receiver-side (parallel/exchange.py): per-edge
+    constants are gathered once, then each iteration touches only the (N,)
+    arrival-time vector. With `mesh` (a 1-D jax.sharding.Mesh over the peer
+    axis) the iteration runs under shard_map — one t_rx all-gather + one
+    convergence-bit psum per iteration over ICI; without it, the same
+    expression on one device.
     """
     n, c = conns.shape
     key, k_rank, k_gossip, k_phase = jax.random.split(state.key, 4)
@@ -147,20 +161,15 @@ def disseminate(
         return jnp.where(has & (rev >= 0), inc, INF)
 
     def converge(rank, k_p, frag_idx, t_pub, send_mask):
+        c = build_recv_constants(
+            conns, rev, lat_edge, tx_ms, rank, k_p, frag_idx, send_mask,
+            can_send, g_tgt, hb_phase, params.proc_delay_ms,
+            params.heartbeat_ms, with_gossip,
+        )
         t0 = jnp.full((n,), INF).at[publisher].set(t_pub)
-
-        def cond(carry):
-            _, changed, it = carry
-            return changed & (it < params.max_relax_iters)
-
-        def body(carry):
-            t_rx, _, it = carry
-            inc = pull(offers(t_rx, rank, k_p, frag_idx, send_mask))
-            t_new = jnp.minimum(t_rx, inc.min(axis=-1))
-            return t_new, jnp.any(t_new < t_rx), it + 1
-
-        t_rx, _, _ = jax.lax.while_loop(cond, body, (t0, jnp.bool_(True), 0))
-        return t_rx
+        if mesh is not None:
+            return converge_sharded(t0, c, params.max_relax_iters, mesh)
+        return converge_recv(t0, c, params.max_relax_iters)
 
     def one_fragment(frag_idx, t_pub):
         rank1 = _ranks_f32(rprio)
@@ -184,7 +193,13 @@ def disseminate(
     # publisher emits fragments back-to-back (main.nim:177-179)
     frag_ids = jnp.arange(fragments, dtype=jnp.float32)
     t_pubs = t0_ms + frag_ids * tx_ms[publisher]
-    t_rx_f, rank_f, k_f, smask_f = jax.vmap(one_fragment)(frag_ids, t_pubs)
+    if mesh is None:
+        t_rx_f, rank_f, k_f, smask_f = jax.vmap(one_fragment)(frag_ids, t_pubs)
+    else:
+        # shard_map doesn't nest under vmap; fragments is static and <= 9
+        # (topogen -f choices), so unroll the fragment axis instead
+        outs = [one_fragment(frag_ids[i], t_pubs[i]) for i in range(fragments)]
+        t_rx_f, rank_f, k_f, smask_f = (jnp.stack(x) for x in zip(*outs))
 
     received = jnp.all(t_rx_f < INF, axis=0)
     t_rx = jnp.where(received, t_rx_f.max(axis=0), INF)  # last fragment completes

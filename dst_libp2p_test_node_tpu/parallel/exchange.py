@@ -175,7 +175,8 @@ def converge_sharded(
 
 
 def place_sharded(mesh: Mesh, *arrays):
-    """Put (N, ...) arrays row-sharded on the peer mesh."""
+    """Put (N, ...) arrays row-sharded on the peer mesh (test harness +
+    ad-hoc placement helper; the Simulator path uses sharding.shard_simulation)."""
     sh = NamedSharding(mesh, P(PEER_AXIS))
     out = tuple(jax.device_put(a, sh) for a in arrays)
     return out if len(out) > 1 else out[0]

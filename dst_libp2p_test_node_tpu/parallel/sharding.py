@@ -22,8 +22,11 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
-def make_peer_mesh(n_devices: int | None = None) -> Mesh:
-    devs = jax.devices()
+def make_peer_mesh(n_devices: int | None = None, platform: str | None = None) -> Mesh:
+    """1-D peer mesh over the default backend's devices, or over a specific
+    platform's (e.g. "cpu" to get the XLA_FLAGS-forced virtual host devices
+    even when an accelerator plugin owns the default backend)."""
+    devs = jax.devices(platform)
     if n_devices is not None:
         devs = devs[:n_devices]
     return Mesh(np.array(devs), ("peers",))

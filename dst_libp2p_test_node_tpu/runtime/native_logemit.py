@@ -56,6 +56,13 @@ def _load_native() -> ctypes.CDLL | None:
         return _native
 
 
+def ensure_built() -> bool:
+    """Compile (if needed) and load the native emitter; True when available.
+    Used at image-build time (deploy/Dockerfile) so first boot pays no
+    compile cost."""
+    return _load_native() is not None
+
+
 def format_block(
     msg_id: int,
     peers: np.ndarray,

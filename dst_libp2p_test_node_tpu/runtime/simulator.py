@@ -87,12 +87,23 @@ class MessageRecord:
 
 
 class Simulator:
-    def __init__(self, cfg: ExperimentConfig, topology: Topology | None = None):
+    def __init__(
+        self,
+        cfg: ExperimentConfig,
+        topology: Topology | None = None,
+        mesh=None,
+    ):
+        """`mesh`: optional 1-D jax.sharding.Mesh over the peer axis. When
+        given, state/graph arrays are placed row-sharded across its devices
+        and the dissemination fixpoint runs the explicit shard_map + ICI
+        collective path (parallel/exchange.py). network_size must divide
+        evenly by the device count."""
         import jax.numpy as jnp
 
         cfg.topo.validate()
         cfg.gossipsub.validate()
         self.cfg = cfg
+        self.mesh = mesh
         self.topology = topology or Topology.build(cfg.topo)
         n = cfg.topo.network_size
         self.graph = build_connection_graph(
@@ -115,6 +126,21 @@ class Simulator:
         self._stage = jnp.asarray(self.topology.stage_of_peer)
         self._lat = jnp.asarray(self.topology.latency_ms)
         self._bw = jnp.asarray(self.topology.bw_up_mbit)
+        if mesh is not None:
+            from ..parallel.sharding import shard_simulation
+
+            if n % mesh.devices.size != 0:
+                raise ValueError(
+                    f"network_size {n} must divide evenly over "
+                    f"{mesh.devices.size} devices"
+                )
+            topo_arrs = {"stage": self._stage, "lat": self._lat, "bw": self._bw}
+            self.state, self.arrays, topo_arrs = shard_simulation(
+                self.state, self.arrays, topo_arrs, mesh
+            )
+            self._stage, self._lat, self._bw = (
+                topo_arrs["stage"], topo_arrs["lat"], topo_arrs["bw"]
+            )
         self._msg_rng = np.random.default_rng(cfg.seed ^ 0x6D736749)  # msgId stream
         self._hb_carry_ms = 0.0
         self.records: list[MessageRecord] = []
@@ -206,6 +232,7 @@ class Simulator:
             payload_bytes=size,
             fragments=cfg.topo.num_frags,
             with_gossip=cfg.with_gossip,
+            mesh=self.mesh,
         )
         delays = np.asarray(res.delay_ms, dtype=np.float64) + mix_delay
         received = np.asarray(res.received).copy()

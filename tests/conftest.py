@@ -9,6 +9,33 @@ os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.setdefault("JAX_ENABLE_X64", "0")
 
+# Persistent XLA compilation cache: the suite is compile-bound, so repeated
+# pytest runs reuse compiled executables from disk. First run pays full
+# compile; reruns are fast.
+_CACHE_DIR = os.path.join(os.path.dirname(__file__), ".jax_cache")
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", _CACHE_DIR)
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
+
+# The env vars above can come too late: an environment-level sitecustomize may
+# import jax at interpreter startup (pinning jax_platforms to an accelerator
+# plugin before this file runs). config.update after import is authoritative —
+# without it the whole suite silently compiles on the accelerator instead of
+# the 8-device virtual CPU mesh the sharding tests need.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+# feed the (possibly externally-set) env values through config so both paths
+# honor a developer's JAX_COMPILATION_CACHE_DIR / threshold overrides
+jax.config.update(
+    "jax_compilation_cache_dir", os.environ["JAX_COMPILATION_CACHE_DIR"])
+jax.config.update(
+    "jax_persistent_cache_min_compile_time_secs",
+    float(os.environ["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"]))
+jax.config.update(
+    "jax_persistent_cache_min_entry_size_bytes",
+    int(os.environ["JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES"]))
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 

@@ -1,0 +1,90 @@
+"""Checkpoint/resume: a restored experiment continues bit-exactly.
+
+The reference never checkpoints (SURVEY.md §5); this subsystem is an
+improvement the 1M-peer configs need. The contract under test: save at an
+arbitrary point mid-experiment, load in a fresh Simulator, continue both —
+identical heartbeat outcomes, message ids, and delay arrays.
+"""
+
+import numpy as np
+import pytest
+
+from dst_libp2p_test_node_tpu.config.topology import TopoParams
+from dst_libp2p_test_node_tpu.runtime.checkpoint import (
+    load_checkpoint,
+    save_checkpoint,
+)
+from dst_libp2p_test_node_tpu.runtime.simulator import ExperimentConfig, Simulator
+
+
+def _cfg(**kw):
+    topo = TopoParams(
+        network_size=60, anchor_stages=3, min_bandwidth=50, max_bandwidth=150,
+        min_latency=40, max_latency=130, msg_size_bytes=500, messages=2,
+        delay_seconds=1.0,
+    )
+    return ExperimentConfig(topo=topo, connect_to=6, warmup_s=5.0, seed=3, **kw)
+
+
+@pytest.fixture(scope="module")
+def midpoint(tmp_path_factory):
+    """One experiment advanced past warm-up + first publish, checkpointed.
+    `snap` freezes the at-save values (tests mutate the live sim)."""
+    sim = Simulator(_cfg())
+    sim.warmup()
+    sim.publish(4)
+    path = tmp_path_factory.mktemp("ckpt") / "mid.npz"
+    save_checkpoint(sim, str(path))
+    snap = {
+        "n_records": len(sim.records),
+        "rec0_delays": sim.records[0].delays_ms.copy(),
+        "rec0_msg_id": sim.records[0].msg_id,
+        "bytes_tx": np.asarray(sim.state.bytes_tx).copy(),
+        "hb_carry_ms": sim._hb_carry_ms,
+    }
+    return sim, str(path), snap
+
+
+def _finish(sim):
+    sim.advance(3000.0)
+    rec = sim.publish(7, msg_size=500)
+    return rec
+
+
+def test_resume_is_bit_exact(midpoint):
+    sim, path, _ = midpoint
+    restored = load_checkpoint(path)
+
+    a = _finish(sim)
+    b = _finish(restored)
+
+    assert a.msg_id == b.msg_id  # host msgId RNG stream resumed
+    np.testing.assert_array_equal(a.received, b.received)
+    np.testing.assert_allclose(a.delays_ms, b.delays_ms)
+    np.testing.assert_array_equal(
+        np.asarray(sim.state.mesh_mask), np.asarray(restored.state.mesh_mask)
+    )
+    assert float(sim.state.t_ms) == float(restored.state.t_ms)
+
+
+def test_records_and_counters_survive(midpoint):
+    _, path, snap = midpoint
+    restored = load_checkpoint(path)
+
+    assert len(restored.records) == snap["n_records"] == 1
+    np.testing.assert_allclose(restored.records[0].delays_ms, snap["rec0_delays"])
+    assert restored.records[0].msg_id == snap["rec0_msg_id"]
+    np.testing.assert_allclose(
+        np.asarray(restored.state.bytes_tx), snap["bytes_tx"]
+    )
+    assert restored._hb_carry_ms == snap["hb_carry_ms"]
+
+
+def test_config_roundtrip(midpoint):
+    sim, path, _ = midpoint
+    restored = load_checkpoint(path)
+    assert restored.cfg == sim.cfg
+    assert restored.params == sim.params
+    np.testing.assert_array_equal(
+        restored.topology.latency_ms, sim.topology.latency_ms
+    )

@@ -153,3 +153,39 @@ class TestNodeMetrics:
         m.on_publish_request(ok=False)
         assert m.publish_failures.get(m.labels) == 1
         assert m.publish_requests.get(m.labels) == 1
+
+
+class TestInjector:
+    """Publisher-controller client (runtime/publisher.py): the
+    pod-api-requester / traffic_sync analog driving /publish."""
+
+    def test_inject_id_selection(self, service):
+        from dst_libp2p_test_node_tpu.runtime.publisher import inject
+
+        before = len(service.sim.records)
+        res = inject(
+            [f"127.0.0.1:{service.control_port}"], msg_size=500, messages=3,
+            delay_s=0.0, peer_selection="id",
+        )
+        assert res.ok == 3 and res.failed == 0
+        assert all(r["status"] == "success" for r in res.replies)
+        service.pump()
+        assert len(service.sim.records) == before + 3
+
+    def test_inject_rotation_and_errors(self, service):
+        from dst_libp2p_test_node_tpu.runtime.publisher import inject
+
+        # rotation across a live target and a dead one: failures are counted,
+        # the loop continues
+        res = inject(
+            [f"127.0.0.1:{service.control_port}", "127.0.0.1:1"],
+            msg_size=500, messages=4, delay_s=0.0, peer_selection="rotation",
+            timeout_s=2.0,
+        )
+        assert res.ok == 2 and res.failed == 2
+
+    def test_bad_selection_rejected(self):
+        from dst_libp2p_test_node_tpu.runtime.publisher import inject
+
+        with pytest.raises(ValueError):
+            inject(["x"], 100, 1, 0.0, peer_selection="nope")

@@ -1,0 +1,62 @@
+"""End-to-end sharded execution: a Simulator on an 8-device peer mesh
+produces the same experiment results as the single-device run.
+
+This is the multi-chip contract (SURVEY.md §2 parallelism table): peers
+row-sharded over a 1-D Mesh, heartbeats auto-partitioned by XLA, the
+dissemination fixpoint on the explicit shard_map + all-gather/psum path
+(parallel/exchange.py via ops/disseminate.py `mesh=`)."""
+
+import jax
+import numpy as np
+import pytest
+
+from dst_libp2p_test_node_tpu.config.topology import TopoParams
+from dst_libp2p_test_node_tpu.parallel.sharding import make_peer_mesh
+from dst_libp2p_test_node_tpu.runtime.simulator import ExperimentConfig, Simulator
+
+
+def _cfg(**kw):
+    topo = TopoParams(
+        network_size=64, anchor_stages=2, min_bandwidth=50, max_bandwidth=100,
+        min_latency=40, max_latency=80, msg_size_bytes=2000, **kw
+    )
+    return ExperimentConfig(topo=topo, connect_to=6, warmup_s=3.0, seed=11)
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 (virtual) devices")
+def test_sharded_simulator_matches_single_device():
+    a = Simulator(_cfg())
+    a.warmup()
+    ra = a.publish(4)
+
+    b = Simulator(_cfg(), mesh=make_peer_mesh(8))
+    b.warmup()
+    rb = b.publish(4)
+
+    np.testing.assert_array_equal(ra.received, rb.received)
+    np.testing.assert_allclose(ra.delays_ms, rb.delays_ms, rtol=1e-5)
+    np.testing.assert_array_equal(ra.sends, rb.sends)
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 (virtual) devices")
+def test_sharded_fragments_unrolled():
+    a = Simulator(_cfg(num_frags=2))
+    a.warmup()
+    ra = a.publish(4)
+
+    b = Simulator(_cfg(num_frags=2), mesh=make_peer_mesh(8))
+    b.warmup()
+    rb = b.publish(4)
+
+    np.testing.assert_array_equal(ra.received, rb.received)
+    np.testing.assert_allclose(ra.delays_ms, rb.delays_ms, rtol=1e-5)
+
+
+def test_uneven_shard_rejected():
+    with pytest.raises(ValueError):
+        Simulator(
+            ExperimentConfig(
+                topo=TopoParams(network_size=60), connect_to=6
+            ),
+            mesh=make_peer_mesh(8),
+        )
