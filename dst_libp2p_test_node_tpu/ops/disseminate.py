@@ -45,10 +45,12 @@ import jax
 import jax.numpy as jnp
 from flax import struct
 
-from ..parallel.exchange import (
-    build_recv_constants,
-    converge_recv,
-    converge_sharded,
+from ..parallel.exchange import build_recv_constants, converge_sharded
+from .pull import (
+    neighbor_pull_bool,
+    neighbor_pull_min,
+    reciprocal_pull_bool,
+    reciprocal_pull_min,
 )
 from .state import SimParams, SimState
 
@@ -118,7 +120,7 @@ def disseminate(
     # connected topic peer (main.nim:279)
     has = conns >= 0
     q_idx = jnp.clip(conns, 0)
-    valid = has & state.alive[q_idx] & state.subscribed[q_idx]
+    valid = has & neighbor_pull_bool(state.alive & state.subscribed, conns, rev)
     tgt = state.mesh_mask & valid
     if params.flood_publish:
         is_pub = jnp.arange(n) == publisher
@@ -156,20 +158,55 @@ def disseminate(
         return cand
 
     def pull(cand):
-        """incoming[q, j] = offer made to q by the neighbor in its slot j."""
-        inc = cand[q_idx, jnp.clip(rev, 0)]
-        return jnp.where(has & (rev >= 0), inc, INF)
+        """incoming[q, j] = offer made to q by the neighbor in its slot j
+        (row-gather + fused slot select; see ops/pull.py for why)."""
+        return reciprocal_pull_min(cand, conns, rev)
 
-    def converge(rank, k_p, frag_idx, t_pub, send_mask):
-        c = build_recv_constants(
-            conns, rev, lat_edge, tx_ms, rank, k_p, frag_idx, send_mask,
-            can_send, g_tgt, hb_phase, params.proc_delay_ms,
-            params.heartbeat_ms, with_gossip,
-        )
-        t0 = jnp.full((n,), INF).at[publisher].set(t_pub)
+    def converge(rank, k_p, frag_idx, t_pub, send_mask, t_init=None):
+        """`t_init`: optional warm start. Any pointwise upper bound on the
+        true arrival times converges to the same unique fixpoint (Bellman-
+        Ford from above, non-negative edge costs), in far fewer iterations
+        when the bound is close."""
+        t0 = (jnp.full((n,), INF) if t_init is None else t_init
+              ).at[publisher].set(t_pub)
         if mesh is not None:
+            # sharded: receiver-local constants, one (N,) all-gather + one
+            # psum per iteration over ICI (parallel/exchange.py)
+            c = build_recv_constants(
+                conns, rev, lat_edge, tx_ms, rank, k_p, frag_idx, send_mask,
+                can_send, g_tgt, hb_phase, params.proc_delay_ms,
+                params.heartbeat_ms, with_gossip,
+            )
             return converge_sharded(t0, c, params.max_relax_iters, mesh)
-        return converge_recv(t0, c, params.max_relax_iters)
+        # single device: sender-major offers (loop-invariant parts hoisted
+        # here), row-gather pull per iteration — ~2.5x the per-iteration
+        # speed of a receiver-side index gather (ops/pull.py)
+        queue = (rank + 1.0 + frag_idx * k_p[:, None]) * tx_ms[:, None]
+        a_base = jnp.where(
+            send_mask & can_send[:, None],
+            params.proc_delay_ms + queue + lat_edge, INF)
+        g_base = jnp.where(
+            g_tgt & can_send[:, None],
+            3.0 * lat_edge + tx_ms[:, None], INF)
+
+        def cond(carry):
+            _, changed, it = carry
+            return changed & (it < params.max_relax_iters)
+
+        def body(carry):
+            t_rx, _, it = carry
+            live = (t_rx < INF)[:, None]
+            cand = jnp.where(live, t_rx[:, None] + a_base, INF)
+            if with_gossip:
+                hb = _next_heartbeat(
+                    t_rx + params.proc_delay_ms, hb_phase, params.heartbeat_ms)
+                cand = jnp.minimum(
+                    cand, jnp.where(live, hb[:, None] + g_base, INF))
+            t_new = jnp.minimum(t_rx, pull(cand).min(axis=-1))
+            return t_new, jnp.any(t_new < t_rx), it + 1
+
+        t_rx, _, _ = jax.lax.while_loop(cond, body, (t0, jnp.bool_(True), 0))
+        return t_rx
 
     def one_fragment(frag_idx, t_pub):
         rank1 = _ranks_f32(rprio)
@@ -187,7 +224,9 @@ def disseminate(
         send_mask = tgt & ~back
         rank2 = _ranks_f32(jnp.where(send_mask, rprio, INF))
         k2 = send_mask.sum(axis=-1).astype(jnp.float32)
-        t2 = converge(rank2, k2, frag_idx, t_pub, send_mask)
+        # phase-2 costs are pointwise <= phase-1 (a send slot was removed
+        # from every queue), so t1 is a valid warm start
+        t2 = converge(rank2, k2, frag_idx, t_pub, send_mask, t_init=t1)
         return t2, rank2, k2, send_mask
 
     # publisher emits fragments back-to-back (main.nim:177-179)
@@ -211,12 +250,12 @@ def disseminate(
         made_offer = cand < INF
         inc = pull(cand)
         first_slot = jnp.argmin(inc, axis=-1)
+        q_t = neighbor_pull_min(t_rx_one, conns, rev)  # neighbor arrival times
         # IDONTWANT (v1.2): target announced receipt before our send began
         if payload_bytes >= params.idontwant_threshold_bytes:
             send_start = t_rx_one[:, None] + params.proc_delay_ms + (
                 rank + frag_idx * k_p[:, None]
             ) * tx_ms[:, None]
-            q_t = jnp.where(has, t_rx_one[q_idx], INF)
             idw_arrived = q_t + lat_edge < send_start
             made_offer = made_offer & ~(idw_arrived & send_mask)
         sends = (made_offer & send_mask).sum(axis=-1)
@@ -226,7 +265,9 @@ def disseminate(
             hb = _next_heartbeat(
                 t_rx_one + params.proc_delay_ms, hb_phase, params.heartbeat_ms
             )
-            lacked = jnp.where(has, t_rx_one[q_idx], 0.0) > hb[:, None] + lat_edge
+            # fill on invalid slots is irrelevant: `lacked` is ANDed with
+            # g_tgt (a subset of valid edges) below
+            lacked = q_t > hb[:, None] + lat_edge
             gossip_sent = g_tgt & havers[:, None] & lacked
             iwant = gossip_sent.sum()
             sends = sends + (gossip_sent & made_offer).sum(axis=-1)
@@ -276,8 +317,5 @@ def disseminate(
 
 def _reciprocal_view(edge_mask: jnp.ndarray, conns: jnp.ndarray, rev: jnp.ndarray):
     """view[q, j] = edge_mask[conns[q,j], rev[q,j]] — what my neighbors did to
-    me, expressed in my slot space (pure gather through the reverse map)."""
-    q = jnp.clip(conns, 0)
-    r = jnp.clip(rev, 0)
-    v = edge_mask[q, r]
-    return jnp.where((conns >= 0) & (rev >= 0), v, False)
+    me, expressed in my slot space (row-gather pull; ops/pull.py)."""
+    return reciprocal_pull_bool(edge_mask, conns, rev)

@@ -1,0 +1,76 @@
+"""The reciprocal-permutation pull — THE hot memory primitive of the engine.
+
+Every protocol exchange in the simulator moves data across the static
+directed-edge involution (p, i) <-> (q = conns[p,i], j = rev[p,i]): GRAFT /
+PRUNE reciprocity in the heartbeat, the per-iteration offer pull of the
+dissemination fixpoint, and the post-fixpoint accounting. Semantically each
+is `out[q, j] = vals[conns[q,j], rev[q,j]]` — a gather through two (N, C)
+index vectors.
+
+TPU performance note (measured at N=100k, C=40 on v5e):
+  - two-index-vector gather `vals[conns, rev]`:        ~45 ms (4M random
+    scalar loads; XLA's general gather path)
+  - flattened one-index gather over the (N*C,) table:  ~34 ms
+  - whole-ROW gather `vals[conns]` + fused iota-select: ~11 ms
+
+Row gathers are embedding-style lookups (contiguous C-element reads) that
+the TPU pipeline handles well; the slot-select then happens in registers via
+an iota comparison that XLA fuses into the gather consumer. We trade C x
+read amplification for contiguity and win ~4x. The iota mask is built
+inline (never materialized as an (N, C, C) constant) so peak memory stays
+O(N*C*C) only inside the fused loop body.
+
+The sharded fixpoint (parallel/exchange.py converge_sharded) deliberately
+does NOT use this: its per-iteration cross-shard traffic is the (N,) time
+vector alone, and the pull there is against receiver-local constants.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+INF = jnp.float32(3.4e38)
+
+
+def reciprocal_pull_bool(
+    edge_mask: jnp.ndarray, conns: jnp.ndarray, rev: jnp.ndarray
+) -> jnp.ndarray:
+    """out[q, j] = edge_mask[conns[q,j], rev[q,j]]; False on invalid slots."""
+    c = conns.shape[-1]
+    rows = edge_mask[jnp.clip(conns, 0)]                 # (N, C, C) row gather
+    sel = jnp.arange(c) == jnp.clip(rev, 0)[..., None]   # fused iota compare
+    out = (rows & sel).any(axis=-1)
+    return out & (conns >= 0) & (rev >= 0)
+
+
+def neighbor_pull_bool(
+    per_peer: jnp.ndarray, conns: jnp.ndarray, rev: jnp.ndarray
+) -> jnp.ndarray:
+    """out[q, j] = per_peer[conns[q,j]] (False on invalid slots) — a per-PEER
+    table lookup through the neighbor index. Same row-contiguity trick: the
+    (N,) vector broadcasts to a (N, C) table that is constant along slots,
+    so pulling any slot of the neighbor's row (we use the reverse slot, which
+    is always in range) yields the per-peer value."""
+    table = jnp.broadcast_to(per_peer[:, None], conns.shape)
+    return reciprocal_pull_bool(table, conns, rev)
+
+
+def neighbor_pull_min(
+    per_peer: jnp.ndarray, conns: jnp.ndarray, rev: jnp.ndarray
+) -> jnp.ndarray:
+    """out[q, j] = per_peer[conns[q,j]] for floats; INF on invalid slots."""
+    table = jnp.broadcast_to(per_peer[:, None], conns.shape)
+    return reciprocal_pull_min(table, conns, rev)
+
+
+def reciprocal_pull_min(
+    vals: jnp.ndarray, conns: jnp.ndarray, rev: jnp.ndarray
+) -> jnp.ndarray:
+    """out[q, j] = vals[conns[q,j], rev[q,j]] for float vals; INF on invalid
+    slots. Exactly-one-hot select via masked min (INF-safe: the fill value
+    is the identity of min and also the 'absent' sentinel)."""
+    c = conns.shape[-1]
+    rows = vals[jnp.clip(conns, 0)]
+    sel = jnp.arange(c) == jnp.clip(rev, 0)[..., None]
+    out = jnp.where(sel, rows, INF).min(axis=-1)
+    return jnp.where((conns >= 0) & (rev >= 0), out, INF)

@@ -1,0 +1,210 @@
+"""Multi-topic GossipSub simulation (BASELINE config 3: "10k-peer
+multi-topic, IHAVE/IWANT heartbeat + peer scoring").
+
+The reference nodes run a single topic ("test", gossipsub-queues
+main.nim:450), but the protocol and the Go/Rust metric surfaces are
+per-topic: the tracer keeps mesh size, peer counts, and a topic-health
+classifier per topic string (go-test-node/metrics.go:348-380,
+rust-test-node/src/metrics.rs:158-176). This module generalizes the engine
+to T concurrent topics the TPU way: per-topic protocol state is STACKED on a
+leading topic axis ((T, N, C) arrays) and one `vmap`-ed heartbeat advances
+every topic's mesh in a single device call — topics are the EP-like axis of
+SURVEY.md §2's parallelism table (expert = topic, tokens = messages).
+
+Connections (the underlying switch/transport layer) are shared across
+topics, exactly as one libp2p host multiplexes all topics over one
+connection set; only subscription masks, mesh membership, scores, and
+counters are per-topic.
+
+Subscription model: `subscribe_fraction` < 1 subscribes each peer to each
+topic independently with that probability (seeded, reproducible), mirroring
+how a real fleet joins a subset of topics; 1.0 = everyone on every topic.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config.env import GossipSubParams
+from ..config.topology import Topology, TopoParams
+from ..ops.disseminate import disseminate
+from ..ops.graph import build_connection_graph
+from ..ops.heartbeat import heartbeat_step
+from ..ops.state import SimParams, graph_arrays, init_state
+from .simulator import MUXER_PROC_MS, MessageRecord
+
+
+def tree_stack(trees):
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *trees)
+
+
+def tree_index(stacked, i: int):
+    return jax.tree_util.tree_map(lambda x: x[i], stacked)
+
+
+def tree_set(stacked, i: int, leaf_tree):
+    return jax.tree_util.tree_map(
+        lambda s, x: s.at[i].set(x), stacked, leaf_tree
+    )
+
+
+@partial(jax.jit, static_argnames=("params", "steps"))
+def _run_topic_heartbeats(states, conns, rev, out_mask, params, steps):
+    """lax.scan of the vmapped heartbeat over all topics — module-level so
+    repeated advance() calls hit the jit cache (keyed on shapes + params)."""
+
+    def body(s, _):
+        s = jax.vmap(
+            lambda st: heartbeat_step(st, conns, rev, out_mask, params)
+        )(s)
+        return s, None
+
+    s, _ = jax.lax.scan(body, states, None, length=steps)
+    return s
+
+
+@dataclass
+class MultiTopicConfig:
+    topo: TopoParams = field(default_factory=TopoParams)
+    topics: tuple = ("test",)
+    connect_to: int = 10
+    gossipsub: GossipSubParams = field(default_factory=GossipSubParams)
+    subscribe_fraction: float = 1.0
+    warmup_s: float = 60.0
+    seed: int = 0
+    with_gossip: bool = True
+
+    def validate(self) -> None:
+        self.topo.validate()
+        self.gossipsub.validate()
+        if not self.topics:
+            raise ValueError("need at least one topic")
+        if len(set(self.topics)) != len(self.topics):
+            raise ValueError("duplicate topic names")
+        if not (0.0 < self.subscribe_fraction <= 1.0):
+            raise ValueError("subscribe_fraction must be in (0, 1]")
+
+
+class MultiTopicSimulator:
+    """T topics over one shared connection graph; stacked per-topic state."""
+
+    def __init__(self, cfg: MultiTopicConfig, topology: Topology | None = None):
+        cfg.validate()
+        self.cfg = cfg
+        self.topology = topology or Topology.build(cfg.topo)
+        n = cfg.topo.network_size
+        t = len(cfg.topics)
+        self.graph = build_connection_graph(n, cfg.connect_to, seed=cfg.seed)
+        proc_ms = MUXER_PROC_MS.get(cfg.topo.muxer.lower(), 2.0)
+        self.params = SimParams.from_gossipsub(
+            n, self.graph.capacity, cfg.gossipsub, proc_delay_ms=proc_ms
+        )
+        self.arrays = graph_arrays(self.graph)
+        self._stage = jnp.asarray(self.topology.stage_of_peer)
+        self._lat = jnp.asarray(self.topology.latency_ms)
+        self._bw = jnp.asarray(self.topology.bw_up_mbit)
+
+        rng = np.random.default_rng(np.random.SeedSequence([cfg.seed, 0x709]))
+        states = []
+        self.subscribed_np = np.ones((t, n), dtype=bool)
+        for ti in range(t):
+            st = init_state(self.params, seed=cfg.seed * 131 + ti)
+            if cfg.subscribe_fraction < 1.0:
+                sub = rng.random(n) < cfg.subscribe_fraction
+                # a topic with no subscribers is legal; an empty mesh just
+                # classifies as "no peers" in the health metric
+                self.subscribed_np[ti] = sub
+                st = st.replace(subscribed=jnp.asarray(sub))
+            states.append(st)
+        self.states = tree_stack(states)
+        self._hb_carry_ms = 0.0
+        self.records: list[tuple[str, MessageRecord]] = []
+        self._msg_rng = np.random.default_rng(cfg.seed ^ 0x6D736749)
+
+    # ---------------------------------------------------------------- stepping
+
+    def advance(self, ms: float) -> None:
+        """Advance all topics' meshes together (one vmapped scan on device)."""
+        self._hb_carry_ms += ms
+        hb = self.params.heartbeat_ms
+        steps = int(self._hb_carry_ms // hb)
+        self._hb_carry_ms -= steps * hb
+        if steps <= 0:
+            return
+        a = self.arrays
+        self.states = _run_topic_heartbeats(
+            self.states, a["conns"], a["rev"], a["out_mask"], self.params, steps
+        )
+
+    def warmup(self) -> None:
+        self.advance(self.cfg.warmup_s * 1000.0)
+
+    # --------------------------------------------------------------- publish
+
+    def topic_index(self, topic: str) -> int:
+        try:
+            return self.cfg.topics.index(topic)
+        except ValueError:
+            raise KeyError(f"topic not joined: {topic!r}") from None
+
+    def publish(self, topic: str, publisher: int,
+                msg_size: int | None = None) -> MessageRecord:
+        """One message on one topic; only that topic's state advances."""
+        ti = self.topic_index(topic)
+        size = msg_size if msg_size is not None else self.cfg.topo.msg_size_bytes
+        a = self.arrays
+        st = tree_index(self.states, ti)
+        t0_ms = float(st.t_ms) + self._hb_carry_ms
+        res, st = disseminate(
+            st, a["conns"], a["rev"], self._stage, self._lat, self._bw,
+            publisher=publisher, t0_ms=t0_ms, params=self.params,
+            payload_bytes=size, fragments=self.cfg.topo.num_frags,
+            with_gossip=self.cfg.with_gossip,
+        )
+        self.states = tree_set(self.states, ti, st)
+        delays = np.asarray(res.delay_ms, dtype=np.float64)
+        received = np.asarray(res.received).copy()
+        delays = np.where(received, delays, np.inf)
+        rec = MessageRecord(
+            msg_id=int(self._msg_rng.integers(0, 2**63, dtype=np.int64)),
+            publisher=publisher,
+            t0_ms=t0_ms,
+            delays_ms=delays,
+            received=received,
+            sends=np.asarray(res.sends),
+            copies_rx=np.asarray(res.copies_rx),
+            ihave=int(res.ihave_sent),
+            iwant=int(res.iwant_sent),
+        )
+        self.records.append((topic, rec))
+        return rec
+
+    # --------------------------------------------------------------- metrics
+
+    def mesh_sizes(self) -> dict:
+        """Per-topic mean mesh degree over subscribed+alive peers — the
+        libp2p_gossipsub_peers_per_topic_mesh family, one label per topic."""
+        out = {}
+        mesh = np.asarray(self.states.mesh_mask)       # (T, N, C)
+        alive = np.asarray(self.states.alive)          # (T, N)
+        for ti, name in enumerate(self.cfg.topics):
+            member = self.subscribed_np[ti] & alive[ti]
+            deg = mesh[ti].sum(axis=-1)[member]
+            out[name] = float(deg.mean()) if deg.size else 0.0
+        return out
+
+    def topic_health(self) -> dict:
+        """The Go tracer's 3-way classifier (metrics.go:348-380): a topic is
+        'no' with zero mesh peers, 'low' under D_lo, else 'healthy' — here
+        judged from the publisher-side mean mesh degree."""
+        sizes = self.mesh_sizes()
+        d_lo = self.params.d_low
+        return {
+            name: ("no" if s == 0 else "low" if s < d_lo else "healthy")
+            for name, s in sizes.items()
+        }
