@@ -159,8 +159,10 @@ def disseminate(
 
     def pull(cand):
         """incoming[q, j] = offer made to q by the neighbor in its slot j
-        (row-gather + fused slot select; see ops/pull.py for why)."""
-        return reciprocal_pull_min(cand, conns, rev)
+        (row-gather + fused slot select; see ops/pull.py for why). Runs
+        inside the fragment vmap, so the memory dispatch must see the
+        fragment multiplicity."""
+        return reciprocal_pull_min(cand, conns, rev, batch_factor=fragments)
 
     def converge(rank, k_p, frag_idx, t_pub, send_mask, t_init=None):
         """`t_init`: optional warm start. Any pointwise upper bound on the
@@ -250,7 +252,8 @@ def disseminate(
         made_offer = cand < INF
         inc = pull(cand)
         first_slot = jnp.argmin(inc, axis=-1)
-        q_t = neighbor_pull_min(t_rx_one, conns, rev)  # neighbor arrival times
+        q_t = neighbor_pull_min(  # neighbor arrival times (fragment-vmapped)
+            t_rx_one, conns, rev, batch_factor=fragments)
         # IDONTWANT (v1.2): target announced receipt before our send began
         if payload_bytes >= params.idontwant_threshold_bytes:
             send_start = t_rx_one[:, None] + params.proc_delay_ms + (
@@ -276,7 +279,8 @@ def disseminate(
             ihave = jnp.int32(0)
             iwant = jnp.int32(0)
             sent_any = made_offer & send_mask
-        copies = _reciprocal_view(sent_any, conns, rev).sum(axis=-1)
+        copies = reciprocal_pull_bool(
+            sent_any, conns, rev, batch_factor=fragments).sum(axis=-1)
         return sends, copies, ihave, iwant, first_slot
 
     sends_f, copies_f, ihave_f, iwant_f, first_slot_f = jax.vmap(frag_accounting)(
@@ -315,7 +319,3 @@ def disseminate(
     return result, new_state
 
 
-def _reciprocal_view(edge_mask: jnp.ndarray, conns: jnp.ndarray, rev: jnp.ndarray):
-    """view[q, j] = edge_mask[conns[q,j], rev[q,j]] — what my neighbors did to
-    me, expressed in my slot space (row-gather pull; ops/pull.py)."""
-    return reciprocal_pull_bool(edge_mask, conns, rev)

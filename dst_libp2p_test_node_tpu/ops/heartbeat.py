@@ -33,7 +33,8 @@ def _ranks(priority: jnp.ndarray) -> jnp.ndarray:
 
 
 def _reciprocal_view(
-    edge_mask: jnp.ndarray, conns: jnp.ndarray, rev: jnp.ndarray
+    edge_mask: jnp.ndarray, conns: jnp.ndarray, rev: jnp.ndarray,
+    batch_factor: int = 1,
 ) -> jnp.ndarray:
     """view[q, j] = edge_mask[conns[q,j], rev[q,j]] — the counterpart edge's
     flag seen from my slot space. Because the reverse-slot map is an
@@ -45,17 +46,21 @@ def _reciprocal_view(
     to 4M random scalar loads (~45 ms at N=100k). Gathering whole neighbor
     ROWS (contiguous, embedding-style) and selecting the slot with a fused
     iota-compare is ~4x faster — see ops/pull.py for the measured numbers."""
-    return reciprocal_pull_bool(edge_mask, conns, rev)
+    return reciprocal_pull_bool(edge_mask, conns, rev, batch_factor)
 
 
-@partial(jax.jit, static_argnames=("params",))
+@partial(jax.jit, static_argnames=("params", "batch_factor"))
 def heartbeat_step(
     state: SimState,
     conns: jnp.ndarray,
     rev: jnp.ndarray,
     out_mask: jnp.ndarray,
     params: SimParams,
+    batch_factor: int = 1,
 ) -> SimState:
+    """`batch_factor`: width of any enclosing vmap (e.g. the topic axis of
+    runtime/multitopic.py) so the pull memory dispatch sees the true
+    allocation size (ops/pull.py)."""
     n, c = conns.shape
     key, k_graft, k_keep, k_churn_d, k_churn_u = jax.random.split(state.key, 5)
     t = state.t_ms
@@ -70,7 +75,8 @@ def heartbeat_step(
     has_conn = conns >= 0
     # one pull for the conjunction (alive AND subscribed) — each pull is a
     # full row-gather pass, so fusing the two masks halves the cost
-    nbr_ok = neighbor_pull_bool(alive & state.subscribed, conns, rev)
+    nbr_ok = neighbor_pull_bool(
+        alive & state.subscribed, conns, rev, batch_factor)
     valid = has_conn & alive[:, None] & nbr_ok & state.subscribed[:, None]
 
     mesh = state.mesh_mask & valid  # drop edges to dead/unsubscribed peers
@@ -85,7 +91,7 @@ def heartbeat_step(
     mesh = mesh | grafted
     # GRAFT control msg: counterpart adds us to its mesh (handleGraft accepts
     # unless backed off; overflow is corrected at its own next heartbeat)
-    mesh = mesh | _reciprocal_view(grafted, conns, rev)
+    mesh = mesh | _reciprocal_view(grafted, conns, rev, batch_factor)
     mesh = mesh & valid
 
     # -- PRUNE: |mesh| > D_high -> keep D (D_score best, >= D_out outbound) --
@@ -108,7 +114,7 @@ def heartbeat_step(
     pruned = mesh & ~keep & over[:, None]
     mesh = mesh & ~pruned
     # PRUNE control msg: counterpart drops us; backoff on both sides
-    pruned_by_peer = _reciprocal_view(pruned, conns, rev)
+    pruned_by_peer = _reciprocal_view(pruned, conns, rev, batch_factor)
     backoff = state.backoff_until
     backoff = jnp.where(
         pruned | pruned_by_peer, t + params.prune_backoff_ms, backoff)

@@ -36,7 +36,12 @@ from ..ops.disseminate import disseminate
 from ..ops.graph import build_connection_graph
 from ..ops.heartbeat import heartbeat_step
 from ..ops.state import SimParams, graph_arrays, init_state
-from .simulator import MUXER_PROC_MS, MessageRecord
+from .simulator import (
+    MUXER_PROC_MS,
+    MessageRecord,
+    drain_heartbeat_carry,
+    record_from_result,
+)
 
 
 def tree_stack(trees):
@@ -53,14 +58,17 @@ def tree_set(stacked, i: int, leaf_tree):
     )
 
 
-@partial(jax.jit, static_argnames=("params", "steps"))
-def _run_topic_heartbeats(states, conns, rev, out_mask, params, steps):
+@partial(jax.jit, static_argnames=("params", "steps", "n_topics"))
+def _run_topic_heartbeats(states, conns, rev, out_mask, params, steps, n_topics):
     """lax.scan of the vmapped heartbeat over all topics — module-level so
-    repeated advance() calls hit the jit cache (keyed on shapes + params)."""
+    repeated advance() calls hit the jit cache (keyed on shapes + params).
+    `n_topics` feeds the pull memory dispatch (the vmap multiplies every
+    intermediate by T; ops/pull.py)."""
 
     def body(s, _):
         s = jax.vmap(
-            lambda st: heartbeat_step(st, conns, rev, out_mask, params)
+            lambda st: heartbeat_step(
+                st, conns, rev, out_mask, params, batch_factor=n_topics)
         )(s)
         return s, None
 
@@ -130,15 +138,14 @@ class MultiTopicSimulator:
 
     def advance(self, ms: float) -> None:
         """Advance all topics' meshes together (one vmapped scan on device)."""
-        self._hb_carry_ms += ms
-        hb = self.params.heartbeat_ms
-        steps = int(self._hb_carry_ms // hb)
-        self._hb_carry_ms -= steps * hb
+        steps, self._hb_carry_ms = drain_heartbeat_carry(
+            self._hb_carry_ms, ms, self.params.heartbeat_ms)
         if steps <= 0:
             return
         a = self.arrays
         self.states = _run_topic_heartbeats(
-            self.states, a["conns"], a["rev"], a["out_mask"], self.params, steps
+            self.states, a["conns"], a["rev"], a["out_mask"], self.params,
+            steps, len(self.cfg.topics)
         )
 
     def warmup(self) -> None:
@@ -154,8 +161,18 @@ class MultiTopicSimulator:
 
     def publish(self, topic: str, publisher: int,
                 msg_size: int | None = None) -> MessageRecord:
-        """One message on one topic; only that topic's state advances."""
+        """One message on one topic; only that topic's state advances.
+
+        The publisher must be subscribed: an unsubscribed peer's offers are
+        all masked and the message silently reaches nobody, so we fail fast
+        instead (the reference's unsubscribed-publish path — fanout — is a
+        publish-time peer set the engine does not model yet)."""
         ti = self.topic_index(topic)
+        if not self.subscribed_np[ti][publisher]:
+            raise ValueError(
+                f"peer {publisher} is not subscribed to {topic!r}; "
+                "fanout publish is not modeled — pick a subscriber"
+            )
         size = msg_size if msg_size is not None else self.cfg.topo.msg_size_bytes
         a = self.arrays
         st = tree_index(self.states, ti)
@@ -167,19 +184,11 @@ class MultiTopicSimulator:
             with_gossip=self.cfg.with_gossip,
         )
         self.states = tree_set(self.states, ti, st)
-        delays = np.asarray(res.delay_ms, dtype=np.float64)
-        received = np.asarray(res.received).copy()
-        delays = np.where(received, delays, np.inf)
-        rec = MessageRecord(
+        rec = record_from_result(
+            res,
             msg_id=int(self._msg_rng.integers(0, 2**63, dtype=np.int64)),
             publisher=publisher,
             t0_ms=t0_ms,
-            delays_ms=delays,
-            received=received,
-            sends=np.asarray(res.sends),
-            copies_rx=np.asarray(res.copies_rx),
-            ihave=int(res.ihave_sent),
-            iwant=int(res.iwant_sent),
         )
         self.records.append((topic, rec))
         return rec

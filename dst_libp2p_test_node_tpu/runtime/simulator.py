@@ -63,6 +63,40 @@ class ExperimentConfig:
     mix_d: int = 4
 
 
+def drain_heartbeat_carry(carry_ms: float, ms: float, hb_ms: float):
+    """Advance a fractional-heartbeat accumulator: returns (whole heartbeat
+    steps due, new carry). Shared by every runtime that steps simulated time
+    (Simulator, MultiTopicSimulator)."""
+    carry = carry_ms + ms
+    steps = int(carry // hb_ms)
+    return steps, carry - steps * hb_ms
+
+
+def record_from_result(
+    res, *, msg_id: int, publisher: int, t0_ms: float,
+    extra_delay_ms: float = 0.0, drop_self: int | None = None,
+) -> "MessageRecord":
+    """Build a MessageRecord from a DisseminationResult (shared by the
+    single-topic and multi-topic publish paths). `drop_self`: peer whose own
+    delivery is suppressed (SELFTRIGGER off, main.nim:245)."""
+    delays = np.asarray(res.delay_ms, dtype=np.float64) + extra_delay_ms
+    received = np.asarray(res.received).copy()
+    if drop_self is not None:
+        received[drop_self] = False
+    delays = np.where(received, delays, np.inf)
+    return MessageRecord(
+        msg_id=msg_id,
+        publisher=publisher,
+        t0_ms=t0_ms,
+        delays_ms=delays,
+        received=received,
+        sends=np.asarray(res.sends),
+        copies_rx=np.asarray(res.copies_rx),
+        ihave=int(res.ihave_sent),
+        iwant=int(res.iwant_sent),
+    )
+
+
 @dataclass
 class MessageRecord:
     msg_id: int
@@ -155,10 +189,8 @@ class Simulator:
 
     def advance(self, ms: float) -> None:
         """Advance simulated time by `ms`, running the heartbeats due."""
-        self._hb_carry_ms += ms
-        hb = self.params.heartbeat_ms
-        steps = int(self._hb_carry_ms // hb)
-        self._hb_carry_ms -= steps * hb
+        steps, self._hb_carry_ms = drain_heartbeat_carry(
+            self._hb_carry_ms, ms, self.params.heartbeat_ms)
         if steps > 0:
             a = self.arrays
             self.state = run_heartbeats(
@@ -234,21 +266,14 @@ class Simulator:
             with_gossip=cfg.with_gossip,
             mesh=self.mesh,
         )
-        delays = np.asarray(res.delay_ms, dtype=np.float64) + mix_delay
-        received = np.asarray(res.received).copy()
-        if not cfg.self_trigger:
-            received[origin] = False  # publisher doesn't log its own message
-        delays = np.where(received, delays, np.inf)
-        rec = MessageRecord(
+        rec = record_from_result(
+            res,
             msg_id=int(self._msg_rng.integers(0, 2**63, dtype=np.int64)),
             publisher=origin,
             t0_ms=t0_ms,
-            delays_ms=delays,
-            received=received,
-            sends=np.asarray(res.sends),
-            copies_rx=np.asarray(res.copies_rx),
-            ihave=int(res.ihave_sent),
-            iwant=int(res.iwant_sent),
+            extra_delay_ms=mix_delay,
+            # publisher doesn't log its own message when SELFTRIGGER is off
+            drop_self=None if cfg.self_trigger else origin,
         )
         self.records.append(rec)
         return rec

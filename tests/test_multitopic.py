@@ -92,3 +92,12 @@ def test_config_validation():
         MultiTopicConfig(topics=("x", "x")).validate()
     with pytest.raises(ValueError):
         MultiTopicConfig(subscribe_fraction=0.0).validate()
+
+
+def test_unsubscribed_publisher_rejected():
+    cfg = _cfg(topics=("a",), subscribe_fraction=0.5, seed=9)
+    s = MultiTopicSimulator(cfg)
+    s.warmup()
+    unsub = int(np.nonzero(~s.subscribed_np[0])[0][0])
+    with pytest.raises(ValueError, match="not subscribed"):
+        s.publish("a", publisher=unsub)
