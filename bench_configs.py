@@ -1,0 +1,172 @@
+"""The five BASELINE.json scaling configs as a reproducible runner.
+
+  1. Shadow-parity:   100 peers, CONNECTTO=10, yamux, single publisher
+  2. 1k peers, D=8 mesh, flood-publish only (gossip off)
+  3. 10k peers, MULTI-TOPIC, IHAVE/IWANT heartbeat + peer scoring
+  4. 100k peers, fragmented publish (FRAGMENTS=4), churn + mesh pruning
+  5. 1M peers, mix-routed (MOUNTSMIX/MIXD=4)  [--all only; ~minutes]
+
+Each config prints ONE JSON line: config id, peers, wall seconds,
+peers*rounds/sec, coverage, p50/p99 dissemination latency (ms). Run:
+
+  python bench_configs.py            # configs 1-4
+  python bench_configs.py --all      # include the 1M mix config
+  python bench_configs.py --only 3
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def _percentiles(delays: np.ndarray):
+    ok = np.isfinite(delays)
+    if not ok.any():
+        return 0.0, float("nan"), float("nan")
+    return (
+        float(ok.mean()),
+        float(np.percentile(delays[ok], 50)),
+        float(np.percentile(delays[ok], 99)),
+    )
+
+
+def _emit(config: int, n: int, wall: float, rounds: float, delays, extra=None):
+    cov, p50, p99 = _percentiles(np.asarray(delays))
+    out = {
+        "config": config,
+        "peers": n,
+        "wall_s": round(wall, 2),
+        "peer_rounds_per_sec": round(n * rounds / max(wall, 1e-9), 1),
+        "coverage": round(cov, 4),
+        "p50_ms": round(p50, 1),
+        "p99_ms": round(p99, 1),
+    }
+    if extra:
+        out.update(extra)
+    print(json.dumps(out), flush=True)
+
+
+def _topo(n, msg_size, frags=1):
+    from dst_libp2p_test_node_tpu.config.topology import TopoParams
+
+    return TopoParams(
+        network_size=n, anchor_stages=5, min_bandwidth=50, max_bandwidth=150,
+        min_latency=40, max_latency=130, msg_size_bytes=msg_size,
+        num_frags=frags, messages=3, delay_seconds=2.0,
+    )
+
+
+def _run_simple(config, n, *, gossipsub=None, with_gossip=True, msg_size=15000,
+                frags=1, churn=0.0, uses_mix=False, num_mix=0, messages=3,
+                warmup_s=60.0):
+    import jax
+
+    from dst_libp2p_test_node_tpu.config.env import GossipSubParams
+    from dst_libp2p_test_node_tpu.runtime.simulator import (
+        ExperimentConfig, Simulator)
+
+    cfg = ExperimentConfig(
+        topo=_topo(n, msg_size, frags),
+        connect_to=10,
+        gossipsub=gossipsub or GossipSubParams(),
+        publisher_id=4 + (num_mix if uses_mix else 0),
+        warmup_s=warmup_s,
+        with_gossip=with_gossip,
+        churn_down_per_hb=churn,
+        churn_up_per_hb=churn / 2,
+        uses_mix=uses_mix,
+        num_mix=num_mix,
+        mix_d=4,
+        seed=0,
+    )
+    sim = Simulator(cfg)
+    # warm the compile caches outside the timed window (the reference
+    # excludes image build time from run time)
+    sim.advance(1000.0)
+    sim.publish(cfg.publisher_id, msg_size=msg_size)
+    sim.records.clear()
+    t0 = time.time()
+    sim.advance(cfg.warmup_s * 1000.0)
+    for i in range(messages):
+        if i:
+            sim.advance(2000.0)
+        sim.publish(cfg.publisher_id, msg_size=msg_size)
+    jax.block_until_ready(sim.state.mesh_mask)
+    wall = time.time() - t0
+    delays = np.concatenate([r.delays_ms for r in sim.records])
+    rounds = float(sim.state.t_ms) / sim.params.heartbeat_ms
+    _emit(config, n, wall, rounds, delays)
+
+
+def config_1():
+    _run_simple(1, 100, msg_size=15000, warmup_s=300.0)
+
+
+def config_2():
+    from dst_libp2p_test_node_tpu.config.env import GossipSubParams
+
+    gs = GossipSubParams(d=8, d_low=6, d_high=12, flood_publish=True)
+    _run_simple(2, 1000, gossipsub=gs, with_gossip=False, warmup_s=120.0)
+
+
+def config_3():
+    import jax
+
+    from dst_libp2p_test_node_tpu.runtime.multitopic import (
+        MultiTopicConfig, MultiTopicSimulator)
+
+    cfg = MultiTopicConfig(
+        topo=_topo(10_000, 2000),
+        topics=("blocks", "attestations", "aggregates", "sync"),
+        connect_to=10,
+        subscribe_fraction=0.75,
+        warmup_s=60.0,
+        seed=0,
+    )
+    sim = MultiTopicSimulator(cfg)
+    sim.advance(1000.0)
+    t0 = time.time()
+    sim.warmup()
+    delays = []
+    for ti, topic in enumerate(cfg.topics):
+        pub = int(np.nonzero(sim.subscribed_np[ti])[0][4])
+        rec = sim.publish(topic, pub)
+        delays.append(rec.delays_ms[np.asarray(sim.subscribed_np[ti])])
+        sim.advance(2000.0)
+    jax.block_until_ready(sim.states.mesh_mask)
+    wall = time.time() - t0
+    rounds = float(np.asarray(sim.states.t_ms)[0]) / sim.params.heartbeat_ms
+    _emit(3, 10_000, wall, rounds * len(cfg.topics), np.concatenate(delays),
+          extra={"topics": len(cfg.topics),
+                 "health": sim.topic_health()})
+
+
+def config_4():
+    _run_simple(4, 100_000, msg_size=15000, frags=4, churn=0.001,
+                warmup_s=60.0)
+
+
+def config_5():
+    _run_simple(5, 1_000_000, msg_size=15000, uses_mix=True, num_mix=128,
+                messages=2, warmup_s=30.0)
+
+
+CONFIGS = {1: config_1, 2: config_2, 3: config_3, 4: config_4, 5: config_5}
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--all", action="store_true", help="include the 1M config")
+    p.add_argument("--only", type=int, choices=sorted(CONFIGS), default=None)
+    a = p.parse_args()
+    runs = [a.only] if a.only else ([1, 2, 3, 4, 5] if a.all else [1, 2, 3, 4])
+    for c in runs:
+        CONFIGS[c]()
+
+
+if __name__ == "__main__":
+    main()

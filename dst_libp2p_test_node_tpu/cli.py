@@ -34,7 +34,7 @@ import json
 import sys
 import time
 
-from .config.env import GossipSubParams, env_str
+from .config.env import env_str, gossipsub_params_from_env
 from .config.topology import Topology, TopoParams
 
 # run.sh positional order (run.sh:23-38)
@@ -190,7 +190,9 @@ def cmd_run(argv: list[str]) -> int:
         cfg = ExperimentConfig(
             topo=topo,
             connect_to=a.connect_to,
-            gossipsub=GossipSubParams(),
+            # the reference nodes read GOSSIPSUB_* inside the simulation, so
+            # the driver honors the same env surface (main.nim:252-306)
+            gossipsub=gossipsub_params_from_env(),
             publisher_id=int(a.publisher_id),
             publisher_rotation=bool(int(a.publisher_rotation)),
             warmup_s=a.warmup_s,

@@ -210,20 +210,38 @@ def disseminate(
         t_rx, _, _ = jax.lax.while_loop(cond, body, (t0, jnp.bool_(True), 0))
         return t_rx
 
+    def queue_drop(tgt_mask, frag_idx):
+        """Priority-queue drop model (main.nim:264-299). The reference's
+        queues are per-CONNECTION and hold MESSAGES: the publisher enqueues
+        all fragments back-to-back on every connection (main.nim:177-179),
+        so its per-connection depth for fragment f is f+1 and the newest
+        fragments beyond the cap are dropped — identically on every
+        connection, so a publisher cap < FRAGMENTS blacks the message out
+        network-wide (nobody can assemble it), which is what the reference
+        does too. Relay inter-fragment arrival gaps are >= one link latency
+        (tens of ms >> tx), so relay queues drain between fragments and
+        never overflow. Statically a no-op when the cap cannot bind."""
+        if params.send_queue_cap >= fragments:
+            return tgt_mask
+        is_pub = (jnp.arange(n) == publisher)[:, None]
+        dropped = frag_idx + 1.0 > params.send_queue_cap
+        return tgt_mask & ~(is_pub & dropped)
+
     def one_fragment(frag_idx, t_pub):
-        rank1 = _ranks_f32(rprio)
-        k1 = tgt.sum(axis=-1).astype(jnp.float32)
-        t1 = converge(rank1, k1, frag_idx, t_pub, tgt)
+        tgt_f = queue_drop(tgt, frag_idx)
+        rank1 = _ranks_f32(jnp.where(tgt_f, rprio, INF))
+        k1 = tgt_f.sum(axis=-1).astype(jnp.float32)
+        t1 = converge(rank1, k1, frag_idx, t_pub, tgt_f)
         if not params.exclude_first_sender:
-            return t1, rank1, k1, tgt
+            return t1, rank1, k1, tgt_f
         # phase 2: drop each peer's back-edge to its first sender from the
         # send order and re-run — the slot is simply never occupied
-        inc1 = pull(offers(t1, rank1, k1, frag_idx, tgt))
+        inc1 = pull(offers(t1, rank1, k1, frag_idx, tgt_f))
         first_slot = jnp.argmin(inc1, axis=-1)
         got_remote = (inc1.min(axis=-1) <= t1) & (jnp.arange(n) != publisher)
         back = jnp.zeros((n, c), bool).at[jnp.arange(n), first_slot].set(True)
         back = back & got_remote[:, None]
-        send_mask = tgt & ~back
+        send_mask = tgt_f & ~back
         rank2 = _ranks_f32(jnp.where(send_mask, rprio, INF))
         k2 = send_mask.sum(axis=-1).astype(jnp.float32)
         # phase-2 costs are pointwise <= phase-1 (a send slot was removed
@@ -281,11 +299,25 @@ def disseminate(
             sent_any = made_offer & send_mask
         copies = reciprocal_pull_bool(
             sent_any, conns, rev, batch_factor=fragments).sum(axis=-1)
-        return sends, copies, ihave, iwant, first_slot
+        # slow-peer penalty (main.nim:264-299): deliveries that spent longer
+        # than the threshold in the SENDER's queue mark the sender as slow
+        # in the RECEIVER's score of it (the reciprocal slot) — scoring and
+        # opportunistic grafting then route around low-bandwidth peers.
+        # Weight 0 (the default) statically removes the computation.
+        if params.slow_weight != 0.0:
+            qdelay = (rank + frag_idx * k_p[:, None]) * tx_ms[:, None]
+            slow_send = send_mask & made_offer & (
+                qdelay > params.slow_threshold_ms)
+            slow_inc = reciprocal_pull_bool(
+                slow_send, conns, rev, batch_factor=fragments
+            ).astype(jnp.float32)
+        else:
+            slow_inc = jnp.zeros((n, c), jnp.float32)
+        return sends, copies, ihave, iwant, first_slot, slow_inc
 
-    sends_f, copies_f, ihave_f, iwant_f, first_slot_f = jax.vmap(frag_accounting)(
-        frag_ids, t_rx_f, rank_f, k_f, smask_f
-    )
+    (sends_f, copies_f, ihave_f, iwant_f, first_slot_f, slow_f) = jax.vmap(
+        frag_accounting
+    )(frag_ids, t_rx_f, rank_f, k_f, smask_f)
     sends = sends_f.sum(axis=0).astype(jnp.int32)
     copies = copies_f.sum(axis=0).astype(jnp.int32)
 
@@ -307,9 +339,11 @@ def disseminate(
         iwant_sent=iwant_f.sum().astype(jnp.int32),
     )
     dup = jnp.maximum(copies - fragments, 0)
+    slow_penalty = state.slow_penalty + params.slow_weight * slow_f.sum(axis=0)
     new_state = state.replace(
         key=key,
         fmd=fmd,
+        slow_penalty=slow_penalty,
         bytes_tx=state.bytes_tx + sends.astype(jnp.float32) * frag_bytes,
         bytes_rx=state.bytes_rx + copies.astype(jnp.float32) * frag_bytes,
         dup_rx=state.dup_rx + dup.astype(jnp.int32),

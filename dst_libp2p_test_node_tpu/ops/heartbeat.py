@@ -120,10 +120,29 @@ def heartbeat_step(
         pruned | pruned_by_peer, t + params.prune_backoff_ms, backoff)
     mesh = mesh & ~pruned_by_peer
 
+    # -- opportunistic grafting (v1.1, main.nim:292): when the MEDIAN mesh
+    # score sinks below the threshold, graft up to 2 peers scoring above the
+    # median (escape hatch from a low-quality mesh). Static-gated: at the
+    # disabled default (-10000) the sort never enters the compiled step.
+    og = jnp.zeros_like(mesh)
+    if params.opportunistic_graft_threshold > -9999.0:
+        deg3 = mesh.sum(axis=-1)
+        msort = jnp.sort(jnp.where(mesh, scores, BIG), axis=-1)
+        # upper median (sorted[len/2]) — matches the libp2p implementations
+        k_med = jnp.clip(deg3 // 2, 0, c - 1)
+        median = jnp.take_along_axis(msort, k_med[:, None], axis=-1)[:, 0]
+        low = (median < params.opportunistic_graft_threshold) & (deg3 > 0)
+        og_elig = (valid & ~mesh & (backoff <= t)
+                   & (scores > median[:, None]) & low[:, None])
+        og_prio = jnp.where(og_elig, -scores, BIG)  # best scores first
+        og = (_ranks(og_prio) < 2) & og_elig
+        mesh = mesh | og | _reciprocal_view(og, conns, rev, batch_factor)
+        mesh = mesh & valid
+
     # -- score decay (decayInterval == heartbeat here; main.nim:272-273) -----
     fmd = state.fmd * params.fmd_decay
     fmd = jnp.where(fmd < params.decay_to_zero, 0.0, fmd)
-    slow = state.slow_penalty * 0.2
+    slow = state.slow_penalty * params.slow_decay
     slow = jnp.where(slow < params.decay_to_zero, 0.0, slow)
 
     return state.replace(
@@ -134,7 +153,8 @@ def heartbeat_step(
         alive=alive,
         t_ms=t + params.heartbeat_ms,
         key=key,
-        grafts=state.grafts + grafted.sum(dtype=jnp.int32),
+        grafts=state.grafts + grafted.sum(dtype=jnp.int32)
+        + og.sum(dtype=jnp.int32),
         prunes=state.prunes + pruned.sum(dtype=jnp.int32),
     )
 

@@ -40,6 +40,13 @@ class SimParams:
     fmd_cap: float = 30.0
     fmd_decay: float = 0.9
     decay_to_zero: float = 0.01
+    # slow-peer penalty + priority-queue drop model (main.nim:264-299)
+    slow_weight: float = 0.0          # GOSSIPSUB_SLOW_PEER_PENALTY_WEIGHT
+    slow_threshold_ms: float = 2000.0  # ..._THRESHOLD (seconds in the env)
+    slow_decay: float = 0.2            # ..._DECAY
+    send_queue_cap: int = 1024         # MAX_LOW_PRIORITY_QUEUE_LEN: data msgs
+    # v1.1 opportunistic grafting (main.nim:292); -10000 = disabled
+    opportunistic_graft_threshold: float = -10000.0
     proc_delay_ms: float = 2.0  # per-hop validation/processing latency
     max_relax_iters: int = 48   # bound on the earliest-arrival fixpoint
     exclude_first_sender: bool = True   # don't forward back to the delivering peer
@@ -80,6 +87,11 @@ class SimParams:
             fmd_decay=g.first_message_deliveries_decay,
             decay_to_zero=g.decay_to_zero,
             idontwant_threshold_bytes=g.idontwant_message_threshold,
+            slow_weight=g.slow_peer_penalty_weight,
+            slow_threshold_ms=g.slow_peer_penalty_threshold * 1000.0,
+            slow_decay=g.slow_peer_penalty_decay,
+            send_queue_cap=g.max_low_priority_queue_len,
+            opportunistic_graft_threshold=g.opportunistic_graft_threshold,
             **overrides,
         )
 

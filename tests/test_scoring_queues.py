@@ -1,0 +1,115 @@
+"""Queue-drop model, slow-peer penalty, and opportunistic grafting
+(gossipsub-queues/main.nim:264-306 surface, SURVEY.md §7 step 5)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dst_libp2p_test_node_tpu.ops.disseminate import disseminate
+from dst_libp2p_test_node_tpu.ops.graph import build_connection_graph
+from dst_libp2p_test_node_tpu.ops.heartbeat import heartbeat_step, run_heartbeats
+from dst_libp2p_test_node_tpu.ops.state import SimParams, graph_arrays, init_state
+
+N = 60
+
+
+def _setup(**overrides):
+    graph = build_connection_graph(N, 8, seed=2)
+    params = SimParams(n=N, capacity=graph.capacity, **overrides)
+    state = init_state(params, seed=2)
+    a = graph_arrays(graph)
+    state = run_heartbeats(state, a["conns"], a["rev"], a["out_mask"], params, 10)
+    stage = jnp.zeros((N,), jnp.int32)
+    lat = jnp.full((2, 2), 40.0, jnp.float32)
+    bw = jnp.full((2,), 50.0, jnp.float32)
+    return params, state, a, (stage, lat, bw)
+
+
+def _publish(params, state, a, topo, frags=1):
+    return disseminate(
+        state, a["conns"], a["rev"], *topo, publisher=3, t0_ms=state.t_ms,
+        params=params, payload_bytes=15000, fragments=frags,
+        with_gossip=False,
+    )
+
+
+class TestQueueDrop:
+    def test_publisher_cap_below_fragments_blacks_out(self):
+        # The publisher enqueues all fragments back-to-back on every
+        # connection; cap 2 < FRAGMENTS=4 drops fragments 2..3 identically
+        # on every connection, so no peer can assemble the message — the
+        # reference behaves the same (per-connection message queues).
+        pd, sd, ad, td = _setup(send_queue_cap=2, flood_publish=False)
+        res_d, _ = _publish(pd, sd, ad, td, frags=4)
+        rec = np.asarray(res_d.received)
+        assert rec[3]                      # the publisher trivially has it
+        assert rec.sum() == 1              # nobody else completes
+        pn, sn, an, tn = _setup(flood_publish=False)
+        res_n, _ = _publish(pn, sn, an, tn, frags=4)
+        assert int(res_d.sends.sum()) < int(res_n.sends.sum())
+
+    def test_cap_at_fragments_is_lossless(self):
+        p1, s1, a1, t1 = _setup(send_queue_cap=4, flood_publish=False)
+        r1, _ = _publish(p1, s1, a1, t1, frags=4)
+        assert np.asarray(r1.received).mean() > 0.95
+
+    def test_default_cap_is_noop(self):
+        p1, s1, a1, t1 = _setup()
+        r1, _ = _publish(p1, s1, a1, t1, frags=2)
+        p2, s2, a2, t2 = _setup(send_queue_cap=10_000)
+        r2, _ = _publish(p2, s2, a2, t2, frags=2)
+        np.testing.assert_allclose(
+            np.asarray(r1.delay_ms), np.asarray(r2.delay_ms))
+
+
+class TestSlowPeerPenalty:
+    def test_penalty_accrues_and_lowers_score(self):
+        p, s, a, t = _setup(slow_weight=1.0, slow_threshold_ms=0.5)
+        res, s2 = _publish(p, s, a, t)
+        pen = np.asarray(s2.slow_penalty)
+        assert pen.sum() > 0  # 15 KB at 50 Mbit = 2.4 ms/send > 0.5 ms
+        scores = np.asarray(s2.score(p))
+        assert scores.min() < 0
+
+    def test_zero_weight_accrues_nothing(self):
+        p, s, a, t = _setup()  # default weight 0.0
+        res, s2 = _publish(p, s, a, t)
+        assert float(np.asarray(s2.slow_penalty).sum()) == 0.0
+
+    def test_decay_uses_param(self):
+        p, s, a, t = _setup(slow_weight=1.0, slow_threshold_ms=0.5,
+                            slow_decay=0.5)
+        _, s2 = _publish(p, s, a, t)
+        before = np.asarray(s2.slow_penalty).sum()
+        s3 = heartbeat_step(s2, a["conns"], a["rev"], a["out_mask"], p)
+        after = np.asarray(s3.slow_penalty).sum()
+        assert 0 < after < before
+
+
+class TestOpportunisticGraft:
+    def test_grafts_above_median_peers(self):
+        p, s, a, t = _setup(opportunistic_graft_threshold=5.0)
+        # give every non-mesh edge a high first-message-deliveries credit so
+        # candidates score above the (zero) median of current mesh members
+        fmd = jnp.where(~s.mesh_mask, 10.0, 0.0)
+        s = s.replace(fmd=fmd)
+        before = int(np.asarray(s.mesh_mask).sum())
+        grafts0 = int(s.grafts)
+        s2 = heartbeat_step(s, a["conns"], a["rev"], a["out_mask"], p)
+        assert int(s2.grafts) > grafts0
+        assert int(np.asarray(s2.mesh_mask).sum()) > before
+        # og (plus reciprocal grafts) may overshoot D_high transiently; the
+        # NEXT heartbeat's prune pass pulls every row back within bounds
+        s3 = heartbeat_step(s2, a["conns"], a["rev"], a["out_mask"], p)
+        deg3 = np.asarray(s3.mesh_mask).sum(axis=-1)
+        assert deg3.max() <= p.d_high + 2
+
+    def test_disabled_by_default(self):
+        p, s, a, t = _setup()
+        fmd = jnp.where(~s.mesh_mask, 10.0, 0.0)
+        s_hi = s.replace(fmd=fmd)
+        s2 = heartbeat_step(s_hi, a["conns"], a["rev"], a["out_mask"], p)
+        # healthy mesh (deg in [d_low, d_high]) -> no grafting activity at all
+        deg = np.asarray(s_hi.mesh_mask & (a["conns"] >= 0)).sum(-1)
+        if (deg >= p.d_low).all():
+            assert int(s2.grafts) == int(s_hi.grafts)
