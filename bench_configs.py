@@ -83,19 +83,22 @@ def _run_simple(config, n, *, gossipsub=None, with_gossip=True, msg_size=15000,
         mix_d=4,
         seed=0,
     )
-    sim = Simulator(cfg)
-    # warm the compile caches outside the timed window (the reference
-    # excludes image build time from run time)
-    sim.advance(1000.0)
-    sim.publish(cfg.publisher_id, msg_size=msg_size)
-    sim.records.clear()
+    def experiment():
+        sim = Simulator(cfg)
+        sim.warmup()
+        for i in range(messages):
+            if i:
+                sim.advance(2000.0)
+            sim.publish(cfg.publisher_id, msg_size=msg_size)
+        jax.block_until_ready(sim.state.mesh_mask)
+        return sim
+
+    # throwaway pass compiles every trace the timed experiment uses (the
+    # XLA cache is process-global and keyed on shapes; the reference
+    # likewise excludes image build time from run time)
+    experiment()
     t0 = time.time()
-    sim.advance(cfg.warmup_s * 1000.0)
-    for i in range(messages):
-        if i:
-            sim.advance(2000.0)
-        sim.publish(cfg.publisher_id, msg_size=msg_size)
-    jax.block_until_ready(sim.state.mesh_mask)
+    sim = experiment()
     wall = time.time() - t0
     delays = np.concatenate([r.delays_ms for r in sim.records])
     rounds = float(sim.state.t_ms) / sim.params.heartbeat_ms
@@ -127,17 +130,21 @@ def config_3():
         warmup_s=60.0,
         seed=0,
     )
-    sim = MultiTopicSimulator(cfg)
-    sim.advance(1000.0)
+    def experiment():
+        sim = MultiTopicSimulator(cfg)
+        sim.warmup()
+        delays = []
+        for ti, topic in enumerate(cfg.topics):
+            pub = int(np.nonzero(sim.subscribed_np[ti])[0][4])
+            rec = sim.publish(topic, pub)
+            delays.append(rec.delays_ms[np.asarray(sim.subscribed_np[ti])])
+            sim.advance(2000.0)
+        jax.block_until_ready(sim.states.mesh_mask)
+        return sim, delays
+
+    experiment()  # compile-warm pass (see _run_simple)
     t0 = time.time()
-    sim.warmup()
-    delays = []
-    for ti, topic in enumerate(cfg.topics):
-        pub = int(np.nonzero(sim.subscribed_np[ti])[0][4])
-        rec = sim.publish(topic, pub)
-        delays.append(rec.delays_ms[np.asarray(sim.subscribed_np[ti])])
-        sim.advance(2000.0)
-    jax.block_until_ready(sim.states.mesh_mask)
+    sim, delays = experiment()
     wall = time.time() - t0
     rounds = float(np.asarray(sim.states.t_ms)[0]) / sim.params.heartbeat_ms
     _emit(3, 10_000, wall, rounds * len(cfg.topics), np.concatenate(delays),

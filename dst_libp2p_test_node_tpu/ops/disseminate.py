@@ -339,7 +339,8 @@ def disseminate(
         iwant_sent=iwant_f.sum().astype(jnp.int32),
     )
     dup = jnp.maximum(copies - fragments, 0)
-    slow_penalty = state.slow_penalty + params.slow_weight * slow_f.sum(axis=0)
+    # the counter accrues unweighted; score() applies the (negative) weight
+    slow_penalty = state.slow_penalty + slow_f.sum(axis=0)
     new_state = state.replace(
         key=key,
         fmd=fmd,

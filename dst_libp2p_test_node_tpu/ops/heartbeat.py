@@ -57,10 +57,14 @@ def heartbeat_step(
     out_mask: jnp.ndarray,
     params: SimParams,
     batch_factor: int = 1,
+    nbr_ok: jnp.ndarray | None = None,
 ) -> SimState:
     """`batch_factor`: width of any enclosing vmap (e.g. the topic axis of
     runtime/multitopic.py) so the pull memory dispatch sees the true
-    allocation size (ops/pull.py)."""
+    allocation size (ops/pull.py). `nbr_ok`: optional precomputed neighbor
+    alive&subscribed pull — pass it when alive/subscribed cannot change
+    between steps (churn off) to hoist the pull out of a scan
+    (run_heartbeats); XLA cannot prove loop-carried state invariant itself."""
     n, c = conns.shape
     key, k_graft, k_keep, k_churn_d, k_churn_u = jax.random.split(state.key, 5)
     t = state.t_ms
@@ -71,12 +75,14 @@ def heartbeat_step(
         dies = jax.random.uniform(k_churn_d, (n,)) < params.churn_down_per_hb
         revives = jax.random.uniform(k_churn_u, (n,)) < params.churn_up_per_hb
         alive = jnp.where(alive, ~dies, revives)
+        nbr_ok = None  # alive just changed; a precomputed pull is stale
 
     has_conn = conns >= 0
-    # one pull for the conjunction (alive AND subscribed) — each pull is a
-    # full row-gather pass, so fusing the two masks halves the cost
-    nbr_ok = neighbor_pull_bool(
-        alive & state.subscribed, conns, rev, batch_factor)
+    if nbr_ok is None:
+        # one pull for the conjunction (alive AND subscribed) — each pull is
+        # a full row-gather pass, so fusing the two masks halves the cost
+        nbr_ok = neighbor_pull_bool(
+            alive & state.subscribed, conns, rev, batch_factor)
     valid = has_conn & alive[:, None] & nbr_ok & state.subscribed[:, None]
 
     mesh = state.mesh_mask & valid  # drop edges to dead/unsubscribed peers
@@ -90,35 +96,53 @@ def heartbeat_step(
     grafted = (_ranks(g_prio) < need[:, None]) & eligible
     mesh = mesh | grafted
     # GRAFT control msg: counterpart adds us to its mesh (handleGraft accepts
-    # unless backed off; overflow is corrected at its own next heartbeat)
-    mesh = mesh | _reciprocal_view(grafted, conns, rev, batch_factor)
-    mesh = mesh & valid
+    # unless backed off; overflow is corrected at its own next heartbeat).
+    # At steady state nothing grafts, so the reciprocal pull — the expensive
+    # op of this step — runs under a cond and is skipped entirely.
+    mesh = jax.lax.cond(
+        grafted.any(),
+        lambda m: (m | _reciprocal_view(grafted, conns, rev, batch_factor))
+        & valid,
+        lambda m: m,
+        mesh,
+    )
 
     # -- PRUNE: |mesh| > D_high -> keep D (D_score best, >= D_out outbound) --
+    # The whole selection (4 rank passes) plus the reciprocal pull runs under
+    # a cond: at steady state no row exceeds D_high and the step skips it.
     deg2 = mesh.sum(axis=-1)
     over = deg2 > params.d_high
-    rand_keep = jax.random.uniform(k_keep, (n, c))
-    # rank by descending score (random tiebreak) among mesh members
-    s_prio = jnp.where(mesh, -scores + 1e-3 * rand_keep, BIG)
-    top_score = (_ranks(s_prio) < params.d_score) & mesh
-    # at least D_out outbound among the kept set
-    out_in_top = (top_score & out_mask).sum(axis=-1)
-    need_out = jnp.clip(params.d_out - out_in_top, 0, params.d)
-    o_prio = jnp.where(mesh & out_mask & ~top_score, rand_keep, BIG)
-    keep_out = (_ranks(o_prio) < need_out[:, None]) & mesh & out_mask & ~top_score
-    # random fill to exactly D
-    base = top_score | keep_out
-    need_fill = jnp.clip(params.d - base.sum(axis=-1), 0, params.d)
-    f_prio = jnp.where(mesh & ~base, rand_keep, BIG)
-    keep = base | ((_ranks(f_prio) < need_fill[:, None]) & mesh & ~base)
-    pruned = mesh & ~keep & over[:, None]
-    mesh = mesh & ~pruned
-    # PRUNE control msg: counterpart drops us; backoff on both sides
-    pruned_by_peer = _reciprocal_view(pruned, conns, rev, batch_factor)
-    backoff = state.backoff_until
-    backoff = jnp.where(
-        pruned | pruned_by_peer, t + params.prune_backoff_ms, backoff)
-    mesh = mesh & ~pruned_by_peer
+
+    def do_prune(mesh):
+        rand_keep = jax.random.uniform(k_keep, (n, c))
+        # rank by descending score (random tiebreak) among mesh members
+        s_prio = jnp.where(mesh, -scores + 1e-3 * rand_keep, BIG)
+        top_score = (_ranks(s_prio) < params.d_score) & mesh
+        # at least D_out outbound among the kept set
+        out_in_top = (top_score & out_mask).sum(axis=-1)
+        need_out = jnp.clip(params.d_out - out_in_top, 0, params.d)
+        o_prio = jnp.where(mesh & out_mask & ~top_score, rand_keep, BIG)
+        keep_out = (_ranks(o_prio) < need_out[:, None]) & mesh & out_mask & ~top_score
+        # random fill to exactly D
+        base = top_score | keep_out
+        need_fill = jnp.clip(params.d - base.sum(axis=-1), 0, params.d)
+        f_prio = jnp.where(mesh & ~base, rand_keep, BIG)
+        keep = base | ((_ranks(f_prio) < need_fill[:, None]) & mesh & ~base)
+        pruned = mesh & ~keep & over[:, None]
+        mesh = mesh & ~pruned
+        # PRUNE control msg: counterpart drops us; backoff on both sides
+        pruned_by_peer = _reciprocal_view(pruned, conns, rev, batch_factor)
+        backoff = jnp.where(
+            pruned | pruned_by_peer,
+            t + params.prune_backoff_ms, state.backoff_until)
+        return mesh & ~pruned_by_peer, backoff, pruned
+
+    mesh, backoff, pruned = jax.lax.cond(
+        over.any(),
+        do_prune,
+        lambda m: (m, state.backoff_until, jnp.zeros_like(m)),
+        mesh,
+    )
 
     # -- opportunistic grafting (v1.1, main.nim:292): when the MEDIAN mesh
     # score sinks below the threshold, graft up to 2 peers scoring above the
@@ -136,8 +160,15 @@ def heartbeat_step(
                    & (scores > median[:, None]) & low[:, None])
         og_prio = jnp.where(og_elig, -scores, BIG)  # best scores first
         og = (_ranks(og_prio) < 2) & og_elig
-        mesh = mesh | og | _reciprocal_view(og, conns, rev, batch_factor)
-        mesh = mesh & valid
+        # same steady-state economics as graft/prune: the reciprocal pull
+        # only runs when something actually grafted
+        mesh = jax.lax.cond(
+            og.any(),
+            lambda m: (m | og | _reciprocal_view(og, conns, rev, batch_factor))
+            & valid,
+            lambda m: m,
+            mesh,
+        )
 
     # -- score decay (decayInterval == heartbeat here; main.nim:272-273) -----
     fmd = state.fmd * params.fmd_decay
@@ -174,8 +205,15 @@ def run_heartbeats(
     Jitted with static `steps` so repeated same-length segments (the
     simulator's inter-message gaps) hit the compile cache."""
 
+    nbr_ok = None
+    if params.churn_down_per_hb == 0.0 and params.churn_up_per_hb == 0.0:
+        # alive/subscribed are invariant across the scan without churn, so
+        # the neighbor pull — a full row-gather pass — hoists out of the loop
+        nbr_ok = neighbor_pull_bool(state.alive & state.subscribed, conns, rev)
+
     def body(s, _):
-        return heartbeat_step(s, conns, rev, out_mask, params), None
+        return heartbeat_step(
+            s, conns, rev, out_mask, params, nbr_ok=nbr_ok), None
 
     state, _ = jax.lax.scan(body, state, None, length=steps)
     return state

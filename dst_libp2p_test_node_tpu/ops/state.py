@@ -40,8 +40,11 @@ class SimParams:
     fmd_cap: float = 30.0
     fmd_decay: float = 0.9
     decay_to_zero: float = 0.01
-    # slow-peer penalty + priority-queue drop model (main.nim:264-299)
-    slow_weight: float = 0.0          # GOSSIPSUB_SLOW_PEER_PENALTY_WEIGHT
+    # slow-peer penalty + priority-queue drop model (main.nim:264-299).
+    # libp2p scoring convention: penalty WEIGHTS are negative and multiply a
+    # non-negative counter into the score; state.slow_penalty holds the
+    # counter, score() applies the weight.
+    slow_weight: float = 0.0          # GOSSIPSUB_SLOW_PEER_PENALTY_WEIGHT (<0)
     slow_threshold_ms: float = 2000.0  # ..._THRESHOLD (seconds in the env)
     slow_decay: float = 0.2            # ..._DECAY
     send_queue_cap: int = 1024         # MAX_LOW_PRIORITY_QUEUE_LEN: data msgs
@@ -104,7 +107,8 @@ class SimState:
     fanout_mask: jnp.ndarray    # (N, C) bool — fanout set for unsubscribed publishers
     backoff_until: jnp.ndarray  # (N, C) float32 ms — PRUNE backoff per directed edge
     fmd: jnp.ndarray            # (N, C) float32 — firstMessageDeliveries counter
-    slow_penalty: jnp.ndarray   # (N, C) float32 — slowPeerPenalty accumulator
+    slow_penalty: jnp.ndarray   # (N, C) float32 — slowPeerPenalty COUNTER
+    #                             (non-negative; weighted only in score())
     alive: jnp.ndarray          # (N,) bool — churn mask
     subscribed: jnp.ndarray     # (N,) bool — topic membership
     t_ms: jnp.ndarray           # () float32 — sim clock
@@ -120,9 +124,11 @@ class SimState:
 
     def score(self, params: SimParams) -> jnp.ndarray:
         """Peer score as seen across each directed edge (v1.1 subset:
-        P2 firstMessageDeliveries * weight + slow-peer penalty)."""
+        P2 firstMessageDeliveries plus the slow-peer penalty counter, each
+        scaled by its weight — penalty weights are negative by libp2p
+        convention, so the term subtracts)."""
         fmd = jnp.minimum(self.fmd, params.fmd_cap)
-        return params.fmd_weight * fmd - self.slow_penalty
+        return params.fmd_weight * fmd + params.slow_weight * self.slow_penalty
 
 
 def init_state(params: SimParams, seed: int = 0) -> SimState:
