@@ -139,7 +139,20 @@ def disseminate(
     g_tgt = g_cand & (_ranks_f32(gprio) < g_count[:, None])
     hb_phase = jax.random.uniform(k_phase, (n,)) * params.heartbeat_ms
 
-    lat_edge = lat_ms[stage[:, None], stage[q_idx]]  # (N, C) per-slot latency
+    # per-slot link latency lat[stage[p], stage[conns[p,i]]]. The naive
+    # 2-index form costs ~60 ms at 100k (scalar gathers); instead: row-gather
+    # my stage's latency row (contiguous), pull each neighbor's stage id
+    # through the reverse map (ops/pull.py), and select with a fused one-hot
+    # over the S+1-wide stage axis — all vectorized.
+    n_stages = lat_ms.shape[0]
+    lat_rows = lat_ms[stage]                              # (N, S+1)
+    # NOTE: this pull runs once at top level, OUTSIDE the fragment vmap —
+    # batch_factor stays 1 (the vmapped pulls below pass fragments)
+    stage_q = neighbor_pull_min(stage.astype(jnp.float32), conns, rev)
+    lat_edge = jnp.where(
+        stage_q[..., None] == jnp.arange(n_stages, dtype=jnp.float32),
+        lat_rows[:, None, :], 0.0,
+    ).sum(axis=-1)                                        # (N, C); 0 on pads
     can_send = state.alive & state.subscribed
 
     def offers(t_rx, rank, k_p, frag_idx, send_mask):
