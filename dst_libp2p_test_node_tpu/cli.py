@@ -485,6 +485,16 @@ def main(argv: list[str] | None = None) -> int:
         return 2
     cmd, rest = argv[0], argv[1:]
     backend = env_str("SIMBACKEND", "tpu")
+    platform = env_str("SIMPLATFORM", "")
+    if platform and cmd not in ("topogen", "summarize"):
+        # pin the JAX platform before any backend initializes (e.g.
+        # SIMPLATFORM=cpu for small role-based runs where an accelerator's
+        # first-compile latency dominates). config.update is authoritative
+        # even when an environment sitecustomize pre-imported jax. topogen/
+        # summarize are pure numpy — don't pay the jax import for them.
+        import jax
+
+        jax.config.update("jax_platforms", platform)
     if cmd == "topogen":
         return cmd_topogen(rest)
     if cmd == "run":

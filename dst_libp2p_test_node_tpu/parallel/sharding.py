@@ -22,6 +22,28 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
+def initialize_multihost(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> int:
+    """Join a multi-host JAX run (DCN scale-out; SURVEY.md §2 'multi-pod via
+    DCN'). Wraps jax.distributed.initialize: afterwards jax.devices() spans
+    every host's chips and make_peer_mesh() builds the global peer mesh —
+    per-iteration fixpoint collectives ride ICI within a slice and DCN
+    across hosts, with no change to any engine code. Arguments default to
+    the standard JAX env vars (JAX_COORDINATOR_ADDRESS etc.) / TPU metadata.
+    Returns the process index."""
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    return jax.process_index()
+
+
 def make_peer_mesh(n_devices: int | None = None, platform: str | None = None) -> Mesh:
     """1-D peer mesh over the default backend's devices, or over a specific
     platform's (e.g. "cpu" to get the XLA_FLAGS-forced virtual host devices
