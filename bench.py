@@ -77,11 +77,18 @@ def main() -> None:
     jax.block_until_ready(state.mesh_mask)
     coverage = float(np.asarray(res.received).mean())
 
+    import contextlib
+    import os
+
+    profile_dir = os.environ.get("BENCH_PROFILE_DIR", "")
+    prof = (jax.profiler.trace(profile_dir) if profile_dir
+            else contextlib.nullcontext())  # op-level traces on demand
     t0 = time.time()
-    for i in range(MESSAGES):
-        state = hb(state, per_burst)
-        res, state = publish(state, 4 + i)
-    jax.block_until_ready(state.mesh_mask)
+    with prof:
+        for i in range(MESSAGES):
+            state = hb(state, per_burst)
+            res, state = publish(state, 4 + i)
+        jax.block_until_ready(state.mesh_mask)
     wall = time.time() - t0
 
     rounds = MESSAGES * per_burst

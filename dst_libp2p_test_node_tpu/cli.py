@@ -162,7 +162,20 @@ def cmd_run(argv: list[str]) -> int:
     p.add_argument("--out-prefix", default="")
     p.add_argument("--stats-json", action="store_true",
                    help="also write stats<i>.json next to latencies<i>")
+    p.add_argument("--checkpoint", default=None,
+                   help="snapshot the experiment to this .npz during the run "
+                   "(crash-resumable; see --resume; requires runs == 1)")
+    p.add_argument("--checkpoint-every", type=int, default=1,
+                   help="messages between snapshots (raise for long "
+                   "schedules at large N)")
+    p.add_argument("--resume", default=None,
+                   help="resume from a --checkpoint file and finish its "
+                   "remaining schedule (requires runs == 1, same config)")
     a = p.parse_args(argv)
+    if (a.checkpoint or a.resume) and int(a.runs) != 1:
+        # per-run states would overwrite one checkpoint file and a resume
+        # could not tell which run it belongs to
+        p.error("--checkpoint/--resume require runs == 1")
     if a.use_mix:
         # a publisher that is itself a mix node is excluded from its own
         # relay path, so rotation (any ordinal publishes) or a mix-range
@@ -205,8 +218,20 @@ def cmd_run(argv: list[str]) -> int:
             mix_d=a.mix_d,
         )
         t0 = time.time()
-        sim = Simulator(cfg, topology=t)
-        sim.run()
+        if a.resume:
+            from .runtime.checkpoint import load_checkpoint
+
+            sim = load_checkpoint(a.resume)
+            if sim.cfg != cfg:
+                p.error(
+                    "--resume checkpoint was created with a different "
+                    "configuration than these arguments; re-run with the "
+                    "original parameters"
+                )
+        else:
+            sim = Simulator(cfg, topology=t)
+        sim.run(checkpoint_path=a.checkpoint,
+                checkpoint_every=a.checkpoint_every)
         wall = time.time() - t0
         n_lines = sim.write_latencies(f"{a.out_prefix}latencies{i}")
         sim.write_shadowlog(f"{a.out_prefix}shadowlog{i}")  # run.sh:60 artifact

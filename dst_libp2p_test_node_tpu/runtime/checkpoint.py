@@ -26,6 +26,7 @@ as the uninterrupted run (tests/test_checkpoint.py).
 from __future__ import annotations
 
 import json
+import os
 from dataclasses import asdict
 
 import numpy as np
@@ -94,8 +95,12 @@ def save_checkpoint(sim: Simulator, path: str) -> None:
     for k in _TOPO_KEYS:
         arrays[f"topo/{k}"] = np.asarray(getattr(topo, k))
     arrays.update(_records_arrays(sim.records))
-    with open(path, "wb") as f:
+    # atomic replace: a crash mid-write (the exact event checkpoints exist
+    # to survive) must not truncate the previous good snapshot
+    tmp = f"{path}.tmp"
+    with open(tmp, "wb") as f:
         np.savez_compressed(f, **arrays)
+    os.replace(tmp, path)
 
 
 def load_checkpoint(path: str, mesh=None) -> Simulator:

@@ -278,19 +278,41 @@ class Simulator:
         self.records.append(rec)
         return rec
 
-    def run(self) -> list[MessageRecord]:
-        """Full experiment: warm-up, then the injection schedule."""
+    def run(
+        self,
+        checkpoint_path: str | None = None,
+        checkpoint_every: int = 1,
+    ) -> list[MessageRecord]:
+        """Full experiment: warm-up, then the injection schedule.
+
+        `checkpoint_path`: snapshot the experiment there after every
+        `checkpoint_every`-th message (runtime/checkpoint.py; each snapshot
+        re-serializes all state + records, so raise the interval for long
+        schedules at large N); a run resumed from that file via
+        `load_checkpoint(path).run()` continues the remaining schedule
+        bit-exactly."""
         cfg = self.cfg
-        self.warmup()
         n = cfg.topo.network_size
+        done = len(self.records)  # >0 when resumed from a checkpoint
+        if done == 0:
+            self.warmup()
         delay_ms = cfg.topo.delay_seconds * 1000.0
         pub = cfg.publisher_id % n
-        for i in range(cfg.topo.messages):
+        if cfg.publisher_rotation:
+            pub = (pub + done) % n
+        for i in range(done, cfg.topo.messages):
             if i > 0:
                 self.advance(delay_ms)
             self.publish(pub)
             if cfg.publisher_rotation:
                 pub = (pub + 1) % n  # next message from the next peer (run.sh:16-17)
+            if checkpoint_path is not None and (
+                (i + 1) % max(checkpoint_every, 1) == 0
+                or i == cfg.topo.messages - 1
+            ):
+                from .checkpoint import save_checkpoint
+
+                save_checkpoint(self, checkpoint_path)
         return self.records
 
     # --------------------------------------------------------------- outputs
