@@ -171,11 +171,24 @@ def cmd_run(argv: list[str]) -> int:
     p.add_argument("--resume", default=None,
                    help="resume from a --checkpoint file and finish its "
                    "remaining schedule (requires runs == 1, same config)")
+    p.add_argument("--gml", default=None,
+                   help="ingest an existing network_topology.gml (e.g. one "
+                   "the reference topogen generated) instead of rebuilding "
+                   "the topology from the positional parameters")
+    p.add_argument("--msgid-mode", choices=["nim", "go"], default="nim",
+                   help="message-id layout: nim = random id embedded in the "
+                   "payload (main.nim:169), go = timestamp-keyed "
+                   "(go/rust nodes embed no id)")
     a = p.parse_args(argv)
     if (a.checkpoint or a.resume) and int(a.runs) != 1:
         # per-run states would overwrite one checkpoint file and a resume
         # could not tell which run it belongs to
         p.error("--checkpoint/--resume require runs == 1")
+    if a.resume and a.gml:
+        # a resumed run continues on the checkpoint's embedded topology
+        # matrices; silently parsing a (possibly different) GML would
+        # mislead about which links are in effect
+        p.error("--resume restores the checkpoint's topology; drop --gml")
     if a.use_mix:
         # a publisher that is itself a mix node is excluded from its own
         # relay path, so rotation (any ordinal publishes) or a mix-range
@@ -193,9 +206,20 @@ def cmd_run(argv: list[str]) -> int:
     from .runtime.summarize import report
 
     topo = _topo_from_fields(vars(a), muxer=a.muxer)
-    t = Topology.build(topo)
-    t.write_gml(a.out_prefix + "network_topology.gml")
-    t.write_shadow_yaml(a.out_prefix + "shadow.yaml")
+    if a.gml:
+        # run an existing experiment dir: link properties come from the GML
+        # (stage latencies/bandwidths), peers/messages from the positionals
+        t = Topology.from_gml(a.gml, network_size=topo.network_size,
+                              params=topo)
+        topo = t.params
+    elif a.resume:
+        # the checkpoint embeds its topology; do NOT overwrite the
+        # experiment dir's artifacts before (or after) validating it
+        t = None
+    else:
+        t = Topology.build(topo)
+        t.write_gml(a.out_prefix + "network_topology.gml")
+        t.write_shadow_yaml(a.out_prefix + "shadow.yaml")
 
     large = topo.msg_size_bytes >= 1000
     for i in range(1, int(a.runs) + 1):
@@ -216,6 +240,7 @@ def cmd_run(argv: list[str]) -> int:
             uses_mix=a.use_mix,
             num_mix=a.num_mix,
             mix_d=a.mix_d,
+            msgid_mode=a.msgid_mode,
         )
         t0 = time.time()
         if a.resume:

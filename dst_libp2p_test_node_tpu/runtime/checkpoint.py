@@ -85,6 +85,7 @@ def save_checkpoint(sim: Simulator, path: str) -> None:
         "cfg": asdict(sim.cfg),
         "hb_carry_ms": sim._hb_carry_ms,
         "msg_rng_state": sim._msg_rng.bit_generator.state,
+        "last_msg_id": sim._last_msg_id,
         "t_ms": float(sim.state.t_ms),
     }
     arrays: dict = {"meta_json": np.frombuffer(
@@ -138,5 +139,6 @@ def load_checkpoint(path: str, mesh=None) -> Simulator:
         sim.state, _, _ = shard_simulation(sim.state, {}, {}, mesh)
     sim._hb_carry_ms = float(meta["hb_carry_ms"])
     sim._msg_rng.bit_generator.state = meta["msg_rng_state"]
+    sim._last_msg_id = int(meta.get("last_msg_id", -1))
     sim.records = _records_from_arrays(z)
     return sim

@@ -61,6 +61,12 @@ class ExperimentConfig:
     uses_mix: bool = False
     num_mix: int = 0
     mix_d: int = 4
+    # Message-id layout compat (SURVEY §7 quirks). "nim": a random 64-bit id
+    # embedded at payload bytes 8-16 (gossipsub-queues/main.nim:169); "go":
+    # the publish timestamp is the dedup key — Go/Rust embed no random id
+    # (go main.go:63-81, rust main.rs:101-143), so their log lines key by
+    # the LE64 nanosecond timestamp.
+    msgid_mode: str = "nim"
 
 
 def drain_heartbeat_carry(carry_ms: float, ms: float, hb_ms: float):
@@ -136,6 +142,8 @@ class Simulator:
 
         cfg.topo.validate()
         cfg.gossipsub.validate()
+        if cfg.msgid_mode not in ("nim", "go"):
+            raise ValueError(f"unknown msgid_mode {cfg.msgid_mode!r}")
         self.cfg = cfg
         self.mesh = mesh
         self.topology = topology or Topology.build(cfg.topo)
@@ -176,6 +184,7 @@ class Simulator:
                 topo_arrs["stage"], topo_arrs["lat"], topo_arrs["bw"]
             )
         self._msg_rng = np.random.default_rng(cfg.seed ^ 0x6D736749)  # msgId stream
+        self._last_msg_id = -1  # go-mode monotonic timestamp tie-break
         self._hb_carry_ms = 0.0
         self.records: list[MessageRecord] = []
         self.mix_params = None
@@ -266,9 +275,19 @@ class Simulator:
             with_gossip=cfg.with_gossip,
             mesh=self.mesh,
         )
+        if cfg.msgid_mode == "go":
+            # Go/Rust key messages by the embedded LE64 ns timestamp. The
+            # sim clock is float32-coarse, so back-to-back publishes could
+            # collide where real nodes' nanosecond clocks would not —
+            # enforce strict monotonicity the way distinct real publishes
+            # always have distinct timestamps.
+            msg_id = max(int(t0_ms * 1e6), self._last_msg_id + 1)
+            self._last_msg_id = msg_id
+        else:
+            msg_id = int(self._msg_rng.integers(0, 2**63, dtype=np.int64))
         rec = record_from_result(
             res,
-            msg_id=int(self._msg_rng.integers(0, 2**63, dtype=np.int64)),
+            msg_id=msg_id,
             publisher=origin,
             t0_ms=t0_ms,
             extra_delay_ms=mix_delay,

@@ -141,3 +141,30 @@ def test_native_and_python_formatters_agree():
     py = format_block(424242, peers, linenos, delays, force_python=True)
     native = format_block(424242, peers, linenos, delays)
     assert py == native
+
+
+def test_go_msgid_mode_keys_by_timestamp():
+    """Go/Rust embed no random message id; the dedup/log key is the LE64
+    publish timestamp (go main.go:63-81, rust main.rs:101-143) — SURVEY §7's
+    'keep a compat flag' for the payload-layout split."""
+    from dst_libp2p_test_node_tpu.config.topology import TopoParams
+    from dst_libp2p_test_node_tpu.runtime.simulator import (
+        ExperimentConfig, Simulator)
+
+    cfg = ExperimentConfig(
+        topo=TopoParams(network_size=30, msg_size_bytes=400, messages=2,
+                        delay_seconds=1.0),
+        connect_to=5, warmup_s=5.0, seed=1, msgid_mode="go",
+    )
+    sim = Simulator(cfg)
+    sim.run()
+    for rec in sim.records:
+        assert rec.msg_id == int(rec.t0_ms * 1e6)  # ns timestamp key
+    assert sim.records[0].msg_id != sim.records[1].msg_id
+
+    import pytest
+
+    with pytest.raises(ValueError, match="msgid_mode"):
+        Simulator(ExperimentConfig(
+            topo=TopoParams(network_size=30), connect_to=5,
+            msgid_mode="rust"))
