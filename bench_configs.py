@@ -146,7 +146,7 @@ def config_3():
     t0 = time.time()
     sim, delays = experiment()
     wall = time.time() - t0
-    rounds = float(np.asarray(sim.states.t_ms)[0]) / sim.params.heartbeat_ms
+    rounds = float(sim.state.t_ms) / sim.params.heartbeat_ms
     _emit(3, 10_000, wall, rounds * len(cfg.topics), np.concatenate(delays),
           extra={"topics": len(cfg.topics),
                  "health": sim.topic_health()})

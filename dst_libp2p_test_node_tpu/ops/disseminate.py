@@ -252,8 +252,8 @@ def disseminate(
         inc1 = pull(offers(t1, rank1, k1, frag_idx, tgt_f))
         first_slot = jnp.argmin(inc1, axis=-1)
         got_remote = (inc1.min(axis=-1) <= t1) & (jnp.arange(n) != publisher)
-        back = jnp.zeros((n, c), bool).at[jnp.arange(n), first_slot].set(True)
-        back = back & got_remote[:, None]
+        # row-wise one-hot via fused iota compare (scatters serialize on TPU)
+        back = (jnp.arange(c) == first_slot[:, None]) & got_remote[:, None]
         send_mask = tgt_f & ~back
         rank2 = _ranks_f32(jnp.where(send_mask, rprio, INF))
         k2 = send_mask.sum(axis=-1).astype(jnp.float32)
@@ -337,10 +337,10 @@ def disseminate(
     # firstMessageDeliveries: credit the edge that delivered fragment 0 first
     fs = first_slot_f[0]
     got = received & (jnp.arange(n) != publisher)
-    fmd = state.fmd.at[jnp.where(got, jnp.arange(n), n), jnp.where(got, fs, 0)].add(
-        1.0, mode="drop"
-    )
-    fmd = jnp.minimum(fmd, params.fmd_cap)
+    # one credit at each receiver's first-delivery slot: a row-wise one-hot
+    # add (fused elementwise) — scatters serialize on TPU
+    credit = (jnp.arange(c) == fs[:, None]) & got[:, None]
+    fmd = jnp.minimum(state.fmd + credit.astype(jnp.float32), params.fmd_cap)
 
     result = DisseminationResult(
         t_rx_ms=t_rx,

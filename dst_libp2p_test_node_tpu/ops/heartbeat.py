@@ -87,23 +87,40 @@ def heartbeat_step(
 
     mesh = state.mesh_mask & valid  # drop edges to dead/unsubscribed peers
     deg = mesh.sum(axis=-1)
-    scores = state.score(params)
+    # score() is only consumed inside the cond-gated graft/prune/og branches;
+    # computing it lazily there keeps the steady-state step score-free. With
+    # opportunistic grafting enabled the og block needs scores every step
+    # anyway — compute once and share instead of once per branch.
+    _og_enabled = params.opportunistic_graft_threshold > -9999.0
+    _scores = state.score(params) if _og_enabled else None
+
+    def get_scores():
+        return _scores if _scores is not None else state.score(params)
 
     # -- GRAFT: |mesh| < D_low -> add random eligible peers up to D ----------
+    # The whole selection (uniform draw + double argsort + reciprocal pull)
+    # runs under a cond: at steady state every row sits in [D_low, D_high]
+    # and the step skips straight through. Key consumption stays identical
+    # either way (k_graft was split above).
     need = jnp.where(deg < params.d_low, params.d - deg, 0)
-    eligible = valid & ~mesh & (state.backoff_until <= t) & (scores >= 0.0)
-    g_prio = jnp.where(eligible, jax.random.uniform(k_graft, (n, c)), BIG)
-    grafted = (_ranks(g_prio) < need[:, None]) & eligible
-    mesh = mesh | grafted
-    # GRAFT control msg: counterpart adds us to its mesh (handleGraft accepts
-    # unless backed off; overflow is corrected at its own next heartbeat).
-    # At steady state nothing grafts, so the reciprocal pull — the expensive
-    # op of this step — runs under a cond and is skipped entirely.
-    mesh = jax.lax.cond(
-        grafted.any(),
-        lambda m: (m | _reciprocal_view(grafted, conns, rev, batch_factor))
-        & valid,
-        lambda m: m,
+
+    def do_graft(mesh):
+        eligible = (valid & ~mesh & (state.backoff_until <= t)
+                    & (get_scores() >= 0.0))
+        g_prio = jnp.where(eligible, jax.random.uniform(k_graft, (n, c)), BIG)
+        grafted = (_ranks(g_prio) < need[:, None]) & eligible
+        # GRAFT control msg: counterpart adds us to its mesh (handleGraft
+        # accepts unless backed off; overflow is corrected at its own next
+        # heartbeat)
+        mesh = mesh | grafted
+        mesh = (mesh | _reciprocal_view(grafted, conns, rev, batch_factor)
+                ) & valid
+        return mesh, grafted
+
+    mesh, grafted = jax.lax.cond(
+        (need > 0).any(),
+        do_graft,
+        lambda m: (m, jnp.zeros_like(m)),
         mesh,
     )
 
@@ -115,6 +132,7 @@ def heartbeat_step(
 
     def do_prune(mesh):
         rand_keep = jax.random.uniform(k_keep, (n, c))
+        scores = get_scores()
         # rank by descending score (random tiebreak) among mesh members
         s_prio = jnp.where(mesh, -scores + 1e-3 * rand_keep, BIG)
         top_score = (_ranks(s_prio) < params.d_score) & mesh
@@ -150,6 +168,7 @@ def heartbeat_step(
     # disabled default (-10000) the sort never enters the compiled step.
     og = jnp.zeros_like(mesh)
     if params.opportunistic_graft_threshold > -9999.0:
+        scores = get_scores()
         deg3 = mesh.sum(axis=-1)
         msort = jnp.sort(jnp.where(mesh, scores, BIG), axis=-1)
         # upper median (sorted[len/2]) — matches the libp2p implementations
@@ -171,10 +190,21 @@ def heartbeat_step(
         )
 
     # -- score decay (decayInterval == heartbeat here; main.nim:272-273) -----
-    fmd = state.fmd * params.fmd_decay
-    fmd = jnp.where(fmd < params.decay_to_zero, 0.0, fmd)
-    slow = state.slow_penalty * params.slow_decay
-    slow = jnp.where(slow < params.decay_to_zero, 0.0, slow)
+    # gated: once everything decayed to zero (no recent messages) the two
+    # (N, C) rewrite passes per step are skipped
+    def do_decay(fmd, slow):
+        fmd = fmd * params.fmd_decay
+        fmd = jnp.where(fmd < params.decay_to_zero, 0.0, fmd)
+        slow = slow * params.slow_decay
+        slow = jnp.where(slow < params.decay_to_zero, 0.0, slow)
+        return fmd, slow
+
+    fmd, slow = jax.lax.cond(
+        (state.fmd > 0).any() | (state.slow_penalty > 0).any(),
+        do_decay,
+        lambda f, s: (f, s),
+        state.fmd, state.slow_penalty,
+    )
 
     return state.replace(
         mesh_mask=mesh,

@@ -5,28 +5,34 @@ The reference nodes run a single topic ("test", gossipsub-queues
 main.nim:450), but the protocol and the Go/Rust metric surfaces are
 per-topic: the tracer keeps mesh size, peer counts, and a topic-health
 classifier per topic string (go-test-node/metrics.go:348-380,
-rust-test-node/src/metrics.rs:158-176). This module generalizes the engine
-to T concurrent topics the TPU way: per-topic protocol state is STACKED on a
-leading topic axis ((T, N, C) arrays) and one `vmap`-ed heartbeat advances
-every topic's mesh in a single device call — topics are the EP-like axis of
-SURVEY.md §2's parallelism table (expert = topic, tokens = messages).
+rust-test-node/src/metrics.rs:158-176).
 
-Connections (the underlying switch/transport layer) are shared across
-topics, exactly as one libp2p host multiplexes all topics over one
-connection set; only subscription masks, mesh membership, scores, and
-counters are per-topic.
+TPU-first design — topics as VIRTUAL PEERS, not a vmap axis: topic t's copy
+of peer p is row t*N + p of one block-diagonal connection graph (the same
+physical connections repeated per topic with a t*N offset, so no edge
+crosses a topic block — exactly one libp2p host multiplexing independent
+per-topic meshes over one connection set). The ordinary single-topic engine
+then runs unchanged over T*N rows:
+
+  - ONE heartbeat scan advances every topic with no vmap. This matters for
+    speed: the engine's steady-state lax.cond skips (graft/prune/decay are
+    no-ops on stable meshes) vmap-lower to `select`, which executes BOTH
+    branches — a vmapped-topics formulation pays the full rebalance cost
+    every step, the stacked formulation skips it globally.
+  - publish() targets row t*N + p; dissemination cannot leave the topic
+    block (there are no cross-block edges), so per-topic isolation is a
+    property of the graph, not of bookkeeping.
+  - per-topic metrics are reshapes of the flat (T*N, ...) state.
 
 Subscription model: `subscribe_fraction` < 1 subscribes each peer to each
-topic independently with that probability (seeded, reproducible), mirroring
-how a real fleet joins a subset of topics; 1.0 = everyone on every topic.
+topic independently with that probability (seeded, reproducible); 1.0 =
+everyone on every topic.
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from functools import partial
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -34,46 +40,14 @@ from ..config.env import GossipSubParams
 from ..config.topology import Topology, TopoParams
 from ..ops.disseminate import disseminate
 from ..ops.graph import build_connection_graph
-from ..ops.heartbeat import heartbeat_step
-from ..ops.state import SimParams, graph_arrays, init_state
+from ..ops.heartbeat import run_heartbeats
+from ..ops.state import SimParams, init_state
 from .simulator import (
     MUXER_PROC_MS,
     MessageRecord,
     drain_heartbeat_carry,
     record_from_result,
 )
-
-
-def tree_stack(trees):
-    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *trees)
-
-
-def tree_index(stacked, i: int):
-    return jax.tree_util.tree_map(lambda x: x[i], stacked)
-
-
-def tree_set(stacked, i: int, leaf_tree):
-    return jax.tree_util.tree_map(
-        lambda s, x: s.at[i].set(x), stacked, leaf_tree
-    )
-
-
-@partial(jax.jit, static_argnames=("params", "steps", "n_topics"))
-def _run_topic_heartbeats(states, conns, rev, out_mask, params, steps, n_topics):
-    """lax.scan of the vmapped heartbeat over all topics — module-level so
-    repeated advance() calls hit the jit cache (keyed on shapes + params).
-    `n_topics` feeds the pull memory dispatch (the vmap multiplies every
-    intermediate by T; ops/pull.py)."""
-
-    def body(s, _):
-        s = jax.vmap(
-            lambda st: heartbeat_step(
-                st, conns, rev, out_mask, params, batch_factor=n_topics)
-        )(s)
-        return s, None
-
-    s, _ = jax.lax.scan(body, states, None, length=steps)
-    return s
 
 
 @dataclass
@@ -98,54 +72,86 @@ class MultiTopicConfig:
             raise ValueError("subscribe_fraction must be in (0, 1]")
 
 
+class _TopicStateView:
+    """Per-topic view of the flat (T*N, ...) state: every peer-major leaf
+    reshapes to (T, N, ...); scalars pass through. Read-only convenience for
+    metrics/tests."""
+
+    def __init__(self, state, n_topics: int, n_peers: int):
+        self._state = state
+        self._t = n_topics
+        self._n = n_peers
+
+    def __getattr__(self, name):
+        leaf = getattr(self._state, name)
+        if hasattr(leaf, "ndim") and leaf.ndim >= 1 \
+                and leaf.shape[0] == self._t * self._n:
+            return leaf.reshape((self._t, self._n) + leaf.shape[1:])
+        return leaf
+
+
 class MultiTopicSimulator:
-    """T topics over one shared connection graph; stacked per-topic state."""
+    """T topics over one shared connection graph, stacked as virtual peers."""
 
     def __init__(self, cfg: MultiTopicConfig, topology: Topology | None = None):
         cfg.validate()
         self.cfg = cfg
         self.topology = topology or Topology.build(cfg.topo)
         n = cfg.topo.network_size
-        t = len(cfg.topics)
+        tcount = len(cfg.topics)
+        self.n_peers = n
         self.graph = build_connection_graph(n, cfg.connect_to, seed=cfg.seed)
         proc_ms = MUXER_PROC_MS.get(cfg.topo.muxer.lower(), 2.0)
         self.params = SimParams.from_gossipsub(
-            n, self.graph.capacity, cfg.gossipsub, proc_delay_ms=proc_ms
+            tcount * n, self.graph.capacity, cfg.gossipsub,
+            proc_delay_ms=proc_ms,
         )
-        self.arrays = graph_arrays(self.graph)
-        self._stage = jnp.asarray(self.topology.stage_of_peer)
+        # block-diagonal stack: per-topic copies of the same physical edges,
+        # shifted by t*N; padding (-1) stays padding. rev/out_mask are
+        # slot-local, so a plain tile suffices.
+        off = (np.arange(tcount) * n)[:, None, None]
+        conns = np.where(
+            self.graph.conns[None] >= 0, self.graph.conns[None] + off, -1
+        ).reshape(tcount * n, -1)
+        self.arrays = {
+            "conns": jnp.asarray(conns),
+            "rev": jnp.asarray(np.tile(self.graph.rev, (tcount, 1))),
+            "out_mask": jnp.asarray(np.tile(self.graph.out_mask, (tcount, 1))),
+        }
+        self._stage = jnp.asarray(np.tile(self.topology.stage_of_peer, tcount))
         self._lat = jnp.asarray(self.topology.latency_ms)
         self._bw = jnp.asarray(self.topology.bw_up_mbit)
 
         rng = np.random.default_rng(np.random.SeedSequence([cfg.seed, 0x709]))
-        states = []
-        self.subscribed_np = np.ones((t, n), dtype=bool)
-        for ti in range(t):
-            st = init_state(self.params, seed=cfg.seed * 131 + ti)
-            if cfg.subscribe_fraction < 1.0:
-                sub = rng.random(n) < cfg.subscribe_fraction
-                # a topic with no subscribers is legal; an empty mesh just
-                # classifies as "no peers" in the health metric
-                self.subscribed_np[ti] = sub
-                st = st.replace(subscribed=jnp.asarray(sub))
-            states.append(st)
-        self.states = tree_stack(states)
+        self.subscribed_np = np.ones((tcount, n), dtype=bool)
+        if cfg.subscribe_fraction < 1.0:
+            # a topic with no subscribers is legal; an empty mesh just
+            # classifies as "no peers" in the health metric
+            self.subscribed_np = rng.random((tcount, n)) < cfg.subscribe_fraction
+        self.state = init_state(self.params, seed=cfg.seed)
+        self.state = self.state.replace(
+            subscribed=jnp.asarray(self.subscribed_np.reshape(-1)))
         self._hb_carry_ms = 0.0
         self.records: list[tuple[str, MessageRecord]] = []
         self._msg_rng = np.random.default_rng(cfg.seed ^ 0x6D736749)
 
     # ---------------------------------------------------------------- stepping
 
+    @property
+    def states(self) -> _TopicStateView:
+        """(T, N, ...) reshaped view of the flat per-topic state."""
+        return _TopicStateView(self.state, len(self.cfg.topics), self.n_peers)
+
     def advance(self, ms: float) -> None:
-        """Advance all topics' meshes together (one vmapped scan on device)."""
+        """Advance all topics' meshes together (one unbatched scan — see the
+        module docstring for why this beats a vmap over topics)."""
         steps, self._hb_carry_ms = drain_heartbeat_carry(
             self._hb_carry_ms, ms, self.params.heartbeat_ms)
         if steps <= 0:
             return
         a = self.arrays
-        self.states = _run_topic_heartbeats(
-            self.states, a["conns"], a["rev"], a["out_mask"], self.params,
-            steps, len(self.cfg.topics)
+        self.state = run_heartbeats(
+            self.state, a["conns"], a["rev"], a["out_mask"], self.params, steps
         )
 
     def warmup(self) -> None:
@@ -161,7 +167,8 @@ class MultiTopicSimulator:
 
     def publish(self, topic: str, publisher: int,
                 msg_size: int | None = None) -> MessageRecord:
-        """One message on one topic; only that topic's state advances.
+        """One message on one topic; dissemination stays inside the topic's
+        block of the stacked graph by construction.
 
         The publisher must be subscribed: an unsubscribed peer's offers are
         all masked and the message silently reaches nobody, so we fail fast
@@ -175,17 +182,27 @@ class MultiTopicSimulator:
             )
         size = msg_size if msg_size is not None else self.cfg.topo.msg_size_bytes
         a = self.arrays
-        st = tree_index(self.states, ti)
-        t0_ms = float(st.t_ms) + self._hb_carry_ms
-        res, st = disseminate(
-            st, a["conns"], a["rev"], self._stage, self._lat, self._bw,
-            publisher=publisher, t0_ms=t0_ms, params=self.params,
-            payload_bytes=size, fragments=self.cfg.topo.num_frags,
+        n = self.n_peers
+        t0_ms = float(self.state.t_ms) + self._hb_carry_ms
+        res, self.state = disseminate(
+            self.state, a["conns"], a["rev"], self._stage, self._lat,
+            self._bw, publisher=ti * n + publisher, t0_ms=t0_ms,
+            params=self.params, payload_bytes=size,
+            fragments=self.cfg.topo.num_frags,
             with_gossip=self.cfg.with_gossip,
         )
-        self.states = tree_set(self.states, ti, st)
+        blk = slice(ti * n, (ti + 1) * n)
+
+        class _Blk:  # the topic's N-row window of the stacked result
+            delay_ms = res.delay_ms[blk]
+            received = res.received[blk]
+            sends = res.sends[blk]
+            copies_rx = res.copies_rx[blk]
+            ihave_sent = res.ihave_sent
+            iwant_sent = res.iwant_sent
+
         rec = record_from_result(
-            res,
+            _Blk,
             msg_id=int(self._msg_rng.integers(0, 2**63, dtype=np.int64)),
             publisher=publisher,
             t0_ms=t0_ms,
