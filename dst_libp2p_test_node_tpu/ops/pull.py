@@ -43,19 +43,26 @@ _MAX_INTERMEDIATE_BYTES = 6 * 1024**3
 _LANE = 128
 
 
+def exceeds_budget(dtype, conns_shape, batch_factor: int = 1) -> bool:
+    """The dispatch decision, exposed for tests: would the padded row-gather
+    intermediate for this pull exceed the memory budget?
+
+    `batch_factor`: outer vmap width (fragments, topics). Trace-time shapes
+    are per-instance — the REAL allocation is batch_factor times the
+    per-instance intermediate, so the dispatch must account for it or a
+    9-fragment publish would blow an in-budget 2 GiB pull up to 18 GiB."""
+    n, c = conns_shape[-2], conns_shape[-1]
+    itemsize = 1 if dtype == jnp.bool_ else jnp.dtype(dtype).itemsize
+    padded = n * c * max(_LANE, c) * itemsize * max(batch_factor, 1)
+    return padded > _MAX_INTERMEDIATE_BYTES
+
+
 def _row_pull(vals, conns, rev, select, fallback, batch_factor):
     """Size-dispatched core. `select(rows, sel)` reduces the gathered rows;
     `fallback(q, r)` is the direct 2-index gather used when the row-gather
-    intermediate would not fit the budget.
-
-    `batch_factor`: outer vmap width (fragments, topics). Trace-time shapes
-    here are per-instance — the REAL allocation is batch_factor times the
-    per-instance intermediate, so the dispatch must account for it or a
-    9-fragment publish would blow an in-budget 2 GiB pull up to 18 GiB."""
-    n, c = conns.shape[-2], conns.shape[-1]
-    itemsize = 1 if vals.dtype == jnp.bool_ else vals.dtype.itemsize
-    padded = n * c * max(_LANE, c) * itemsize * max(batch_factor, 1)
-    if padded > _MAX_INTERMEDIATE_BYTES:
+    intermediate would not fit the budget (see exceeds_budget)."""
+    c = conns.shape[-1]
+    if exceeds_budget(vals.dtype, conns.shape, batch_factor):
         return fallback(jnp.clip(conns, 0), jnp.clip(rev, 0))
     rows = vals[..., jnp.clip(conns, 0), :]   # (..., N, C, C) contiguous
     sel = jnp.arange(c) == jnp.clip(rev, 0)[..., None]

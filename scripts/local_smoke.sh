@@ -18,25 +18,34 @@ trap 'kill $SERVE_PID 2>/dev/null || true; rm -rf "$DIR"' EXIT
 CONTROL_PORT=${CONTROL_PORT:-18645}
 METRICS_PORT=${METRICS_PORT:-18008}
 
+# -u: unbuffered stdout — the latency-line assertion below reads serve.log
+# after a SIGTERM, which would otherwise lose Python's block-buffered output
 PEERS=50 CONNECTTO=6 MUXER=yamux SIMPLATFORM=${SIMPLATFORM:-cpu} \
-  "$PYTHON" -m dst_libp2p_test_node_tpu serve \
+  "$PYTHON" -u -m dst_libp2p_test_node_tpu serve \
   --control-port "$CONTROL_PORT" --metrics-port "$METRICS_PORT" \
   --warmup-s 10 --tick-s 0.2 --time-scale 5 --duration-s 60 \
   > "$DIR/serve.log" 2>&1 &
 SERVE_PID=$!
 
 # wait for /ready (the k8s readiness contract)
+READY=0
 for i in $(seq 1 120); do
     if curl -sf "http://127.0.0.1:$CONTROL_PORT/ready" >/dev/null 2>&1; then
+        READY=1
         break
     fi
     kill -0 $SERVE_PID 2>/dev/null || { echo "serve died:"; cat "$DIR/serve.log"; exit 1; }
     sleep 1
 done
+[ "$READY" = 1 ] || { echo "FAIL /ready timeout:"; tail "$DIR/serve.log"; exit 1; }
 curl -sf "http://127.0.0.1:$CONTROL_PORT/health" >/dev/null || { echo "FAIL /health"; exit 1; }
 
-"$PYTHON" -m dst_libp2p_test_node_tpu inject "127.0.0.1:$CONTROL_PORT" \
-    -s 2000 -m 3 -d 1.0 > "$DIR/inject.log"
+# capture inject's status explicitly: under `set -e` a bare failing command
+# would abort before the diagnostic below could print
+if ! "$PYTHON" -m dst_libp2p_test_node_tpu inject "127.0.0.1:$CONTROL_PORT" \
+    -s 2000 -m 3 -d 1.0 > "$DIR/inject.log"; then
+    echo "FAIL publish:"; cat "$DIR/inject.log"; exit 1
+fi
 grep -q '"status": "success"' "$DIR/inject.log" || { echo "FAIL publish"; cat "$DIR/inject.log"; exit 1; }
 
 # give the pump a couple of ticks to drain + emit
