@@ -97,6 +97,7 @@ def disseminate(
     fragments: int = 1,
     with_gossip: bool = True,
     mesh=None,
+    loss_stage=None,
 ):
     """Propagate one application message (all fragments) through the mesh.
 
@@ -109,18 +110,55 @@ def disseminate(
     axis) the iteration runs under shard_map — one t_rx all-gather + one
     convergence-bit psum per iteration over ICI; without it, the same
     expression on one device.
+
+    `loss_stage`: optional (S+1, S+1) per-stage-pair packet-loss rate
+    (topogen's packet_loss edges, shadow/topogen.py:21,56). Modeled at
+    message granularity: each directed edge independently fails to carry
+    this message with its loss probability — a deliberately coarser model
+    than Shadow's per-packet loss with TCP retransmission (which mostly
+    turns loss into latency); mesh redundancy then degrades coverage
+    gracefully, which is the effect the knob exists to study. Pass None
+    (not an all-zero matrix) for the lossless fast path.
     """
     n, c = conns.shape
-    key, k_rank, k_gossip, k_phase = jax.random.split(state.key, 4)
+    if loss_stage is not None:
+        key, k_rank, k_gossip, k_phase, k_loss = jax.random.split(state.key, 5)
+    else:
+        # lossless runs keep the pre-loss-feature RNG stream bit-identical
+        key, k_rank, k_gossip, k_phase = jax.random.split(state.key, 4)
 
     frag_bytes = max(payload_bytes // fragments, 16)
     tx_ms = (frag_bytes * 8.0) / (bw_up_mbit_per_stage[stage] * 1e6) * 1e3  # (N,)
 
+    # per-slot link latency lat[stage[p], stage[conns[p,i]]]. The naive
+    # 2-index form costs ~60 ms at 100k (scalar gathers); instead: row-gather
+    # my stage's latency row (contiguous), pull each neighbor's stage id
+    # through the reverse map (ops/pull.py), and select with a fused one-hot
+    # over the S+1-wide stage axis — all vectorized.
+    n_stages = lat_ms.shape[0]
+    stage_iota = jnp.arange(n_stages, dtype=jnp.float32)
+    # NOTE: this pull runs once at top level, OUTSIDE the fragment vmap —
+    # batch_factor stays 1 (the vmapped pulls below pass fragments)
+    stage_q = neighbor_pull_min(stage.astype(jnp.float32), conns, rev)
+    sel_stage = stage_q[..., None] == stage_iota
+    lat_edge = jnp.where(
+        sel_stage, lat_ms[stage][:, None, :], 0.0
+    ).sum(axis=-1)                                        # (N, C); 0 on pads
+
     # forwarding targets: mesh members; the publisher flood-publishes to every
     # connected topic peer (main.nim:279)
     has = conns >= 0
-    q_idx = jnp.clip(conns, 0)
     valid = has & neighbor_pull_bool(state.alive & state.subscribed, conns, rev)
+    if loss_stage is not None:
+        # per-edge message loss (see docstring): the edge's stage-pair loss
+        # rate, sampled once per message per directed edge. `survive` gates
+        # DELIVERY only — a lost copy was still transmitted, so it keeps its
+        # uplink queue slot and its tx-byte accounting; it just never arrives
+        loss_edge = jnp.where(
+            sel_stage, loss_stage[stage][:, None, :], 0.0).sum(axis=-1)
+        survive = jax.random.uniform(k_loss, (n, c)) >= loss_edge
+    else:
+        survive = None
     tgt = state.mesh_mask & valid
     if params.flood_publish:
         is_pub = jnp.arange(n) == publisher
@@ -139,35 +177,29 @@ def disseminate(
     g_tgt = g_cand & (_ranks_f32(gprio) < g_count[:, None])
     hb_phase = jax.random.uniform(k_phase, (n,)) * params.heartbeat_ms
 
-    # per-slot link latency lat[stage[p], stage[conns[p,i]]]. The naive
-    # 2-index form costs ~60 ms at 100k (scalar gathers); instead: row-gather
-    # my stage's latency row (contiguous), pull each neighbor's stage id
-    # through the reverse map (ops/pull.py), and select with a fused one-hot
-    # over the S+1-wide stage axis — all vectorized.
-    n_stages = lat_ms.shape[0]
-    lat_rows = lat_ms[stage]                              # (N, S+1)
-    # NOTE: this pull runs once at top level, OUTSIDE the fragment vmap —
-    # batch_factor stays 1 (the vmapped pulls below pass fragments)
-    stage_q = neighbor_pull_min(stage.astype(jnp.float32), conns, rev)
-    lat_edge = jnp.where(
-        stage_q[..., None] == jnp.arange(n_stages, dtype=jnp.float32),
-        lat_rows[:, None, :], 0.0,
-    ).sum(axis=-1)                                        # (N, C); 0 on pads
     can_send = state.alive & state.subscribed
 
-    def offers(t_rx, rank, k_p, frag_idx, send_mask):
-        """Arrival-time offers made by every peer on every neighbor slot."""
+    def offers(t_rx, rank, k_p, frag_idx, send_mask, deliver_only=False):
+        """Arrival-time offers made by every peer on every neighbor slot.
+        `deliver_only`: additionally mask copies the network loses — use for
+        anything receiver-side (first-sender detection, delivery pulls);
+        leave False for transmit-side accounting (sends, tx bytes)."""
         base = t_rx + params.proc_delay_ms
         # uplink serialization: (rank+1) sends of this fragment, plus the
         # frag_idx earlier fragments each occupying k_p uplink slots
         queue = (rank + 1.0 + frag_idx * k_p[:, None]) * tx_ms[:, None]
         cand = base[:, None] + queue + lat_edge
         live = can_send[:, None] & (t_rx[:, None] < INF)
-        cand = jnp.where(send_mask & live, cand, INF)
+        sm = send_mask
+        gm = g_tgt
+        if deliver_only and survive is not None:
+            sm = sm & survive
+            gm = gm & survive
+        cand = jnp.where(sm & live, cand, INF)
         if with_gossip:
             hb = _next_heartbeat(base, hb_phase, params.heartbeat_ms)
             g = hb[:, None] + 3.0 * lat_edge + tx_ms[:, None]
-            cand = jnp.minimum(cand, jnp.where(g_tgt & live, g, INF))
+            cand = jnp.minimum(cand, jnp.where(gm & live, g, INF))
         return cand
 
     def pull(cand):
@@ -184,12 +216,17 @@ def disseminate(
         when the bound is close."""
         t0 = (jnp.full((n,), INF) if t_init is None else t_init
               ).at[publisher].set(t_pub)
+        # arrival times are about DELIVERY: lost copies never relax an edge
+        # (their queue slots still count — rank/k_p came from the unmasked
+        # send set)
+        deliver = send_mask if survive is None else send_mask & survive
+        g_deliver = g_tgt if survive is None else g_tgt & survive
         if mesh is not None:
             # sharded: receiver-local constants, one (N,) all-gather + one
             # psum per iteration over ICI (parallel/exchange.py)
             c = build_recv_constants(
-                conns, rev, lat_edge, tx_ms, rank, k_p, frag_idx, send_mask,
-                can_send, g_tgt, hb_phase, params.proc_delay_ms,
+                conns, rev, lat_edge, tx_ms, rank, k_p, frag_idx, deliver,
+                can_send, g_deliver, hb_phase, params.proc_delay_ms,
                 params.heartbeat_ms, with_gossip,
             )
             return converge_sharded(t0, c, params.max_relax_iters, mesh)
@@ -198,10 +235,10 @@ def disseminate(
         # speed of a receiver-side index gather (ops/pull.py)
         queue = (rank + 1.0 + frag_idx * k_p[:, None]) * tx_ms[:, None]
         a_base = jnp.where(
-            send_mask & can_send[:, None],
+            deliver & can_send[:, None],
             params.proc_delay_ms + queue + lat_edge, INF)
         g_base = jnp.where(
-            g_tgt & can_send[:, None],
+            g_deliver & can_send[:, None],
             3.0 * lat_edge + tx_ms[:, None], INF)
 
         def cond(carry):
@@ -248,8 +285,9 @@ def disseminate(
         if not params.exclude_first_sender:
             return t1, rank1, k1, tgt_f
         # phase 2: drop each peer's back-edge to its first sender from the
-        # send order and re-run — the slot is simply never occupied
-        inc1 = pull(offers(t1, rank1, k1, frag_idx, tgt_f))
+        # send order and re-run — the slot is simply never occupied. The
+        # first sender is whoever DELIVERED (lost copies can't be it)
+        inc1 = pull(offers(t1, rank1, k1, frag_idx, tgt_f, deliver_only=True))
         first_slot = jnp.argmin(inc1, axis=-1)
         got_remote = (inc1.min(axis=-1) <= t1) & (jnp.arange(n) != publisher)
         # row-wise one-hot via fused iota compare (scatters serialize on TPU)
@@ -279,9 +317,12 @@ def disseminate(
 
     # ---- post-fixpoint accounting (bytes, duplicates, gossip, score) -------
     def frag_accounting(frag_idx, t_rx_one, rank, k_p, send_mask):
+        # tx side (sends, bytes): everything transmitted, lost or not
         cand = offers(t_rx_one, rank, k_p, frag_idx, send_mask)
         made_offer = cand < INF
-        inc = pull(cand)
+        # rx side (first-delivery attribution): delivered copies only
+        inc = pull(offers(t_rx_one, rank, k_p, frag_idx, send_mask,
+                          deliver_only=True))
         first_slot = jnp.argmin(inc, axis=-1)
         q_t = neighbor_pull_min(  # neighbor arrival times (fragment-vmapped)
             t_rx_one, conns, rev, batch_factor=fragments)
@@ -310,8 +351,10 @@ def disseminate(
             ihave = jnp.int32(0)
             iwant = jnp.int32(0)
             sent_any = made_offer & send_mask
+        # receivers only count copies the network actually delivered
+        arrived = sent_any if survive is None else sent_any & survive
         copies = reciprocal_pull_bool(
-            sent_any, conns, rev, batch_factor=fragments).sum(axis=-1)
+            arrived, conns, rev, batch_factor=fragments).sum(axis=-1)
         # slow-peer penalty (main.nim:264-299): deliveries that spent longer
         # than the threshold in the SENDER's queue mark the sender as slow
         # in the RECEIVER's score of it (the reciprocal slot) — scoring and

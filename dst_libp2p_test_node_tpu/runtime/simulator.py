@@ -168,6 +168,12 @@ class Simulator:
         self._stage = jnp.asarray(self.topology.stage_of_peer)
         self._lat = jnp.asarray(self.topology.latency_ms)
         self._bw = jnp.asarray(self.topology.bw_up_mbit)
+        # per-stage-pair packet loss (topogen -l); None keeps the lossless
+        # fast path out of the compiled step entirely
+        self._loss = (
+            jnp.asarray(self.topology.packet_loss)
+            if float(np.max(self.topology.packet_loss)) > 0.0 else None
+        )
         if mesh is not None:
             from ..parallel.sharding import shard_simulation
 
@@ -177,12 +183,15 @@ class Simulator:
                     f"{mesh.devices.size} devices"
                 )
             topo_arrs = {"stage": self._stage, "lat": self._lat, "bw": self._bw}
+            if self._loss is not None:
+                topo_arrs["loss"] = self._loss
             self.state, self.arrays, topo_arrs = shard_simulation(
                 self.state, self.arrays, topo_arrs, mesh
             )
             self._stage, self._lat, self._bw = (
                 topo_arrs["stage"], topo_arrs["lat"], topo_arrs["bw"]
             )
+            self._loss = topo_arrs.get("loss")
         self._msg_rng = np.random.default_rng(cfg.seed ^ 0x6D736749)  # msgId stream
         self._last_msg_id = -1  # go-mode monotonic timestamp tie-break
         self._hb_carry_ms = 0.0
@@ -274,6 +283,7 @@ class Simulator:
             fragments=cfg.topo.num_frags,
             with_gossip=cfg.with_gossip,
             mesh=self.mesh,
+            loss_stage=self._loss,
         )
         if cfg.msgid_mode == "go":
             # Go/Rust key messages by the embedded LE64 ns timestamp. The

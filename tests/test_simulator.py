@@ -123,3 +123,26 @@ def test_churn_configured_run():
         # dead peers never log receipt
         assert r.received.sum() <= 100
     assert alive.sum() < 100  # some churn actually happened over 30+ hb
+
+
+def test_packet_loss_degrades_coverage():
+    """topogen's -l packet loss, applied as per-edge message loss
+    (ops/disseminate.py loss_stage): heavy loss must strictly reduce
+    delivered copies vs the same seeded lossless run, and moderate loss
+    leaves coverage graceful (mesh redundancy)."""
+
+    def run(loss):
+        topo = TopoParams(network_size=80, anchor_stages=2, min_bandwidth=50,
+                          max_bandwidth=100, min_latency=30, max_latency=60,
+                          msg_size_bytes=500, packet_loss=loss, messages=1)
+        cfg = ExperimentConfig(topo=topo, connect_to=6, warmup_s=5.0, seed=3)
+        sim = Simulator(cfg)
+        sim.warmup()
+        return sim.publish(4)
+
+    clean = run(0.0)
+    heavy = run(0.9)
+    assert clean.received.mean() == 1.0
+    assert heavy.received.sum() < clean.received.sum()
+    mild = run(0.05)
+    assert mild.received.mean() > 0.9  # redundancy keeps coverage graceful
