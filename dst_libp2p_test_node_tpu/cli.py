@@ -305,7 +305,12 @@ def cmd_serve(argv: list[str]) -> int:
     p.add_argument("--store-metrics-dir", default=None)
     a = p.parse_args(argv)
 
-    from .config.env import HTTP_CONTROL_PORT, PROMETHEUS_PORT, get_peer_details
+    from .config.env import (
+        HTTP_CONTROL_PORT,
+        PROMETHEUS_PORT,
+        env_float,
+        get_peer_details,
+    )
     from .runtime.node_service import serve_forever
     from .runtime.simulator import ExperimentConfig, Simulator
 
@@ -316,18 +321,41 @@ def cmd_serve(argv: list[str]) -> int:
         muxer=node.muxer,
         num_frags=node.fragments,
     )
-    cfg = ExperimentConfig(
-        topo=topo,
-        connect_to=node.connect_to,
-        gossipsub=node.gossipsub,
-        warmup_s=a.warmup_s,
-        self_trigger=node.self_trigger,
-        max_connections=node.max_connections,
-        uses_mix=node.uses_mix,
-        num_mix=node.num_mix,
-        mix_d=node.mix_d,
-    )
-    sim = Simulator(cfg)
+    topics = tuple(
+        s.strip() for s in env_str("TOPICS", "").split(",") if s.strip())
+    if len(topics) == 1:
+        node.topic = topics[0]  # single custom topic, single-topic engine
+    if len(topics) > 1:
+        # multi-topic node: /publish routes by topic name (BASELINE config 3
+        # surface); SUBSCRIBE_FRACTION < 1 subscribes each peer per topic
+        if node.uses_mix or node.mounts_mix:
+            p.error("mix routing (USESMIX/MOUNTSMIX) is single-topic only; "
+                    "drop TOPICS or the mix surface")
+        from .runtime.multitopic import MultiTopicConfig, MultiTopicSimulator
+
+        sim = MultiTopicSimulator(MultiTopicConfig(
+            topo=topo,
+            topics=topics,
+            connect_to=node.connect_to,
+            gossipsub=node.gossipsub,
+            warmup_s=a.warmup_s,
+            subscribe_fraction=env_float("SUBSCRIBE_FRACTION", 1.0),
+            max_connections=node.max_connections,
+            self_trigger=node.self_trigger,
+        ))
+    else:
+        cfg = ExperimentConfig(
+            topo=topo,
+            connect_to=node.connect_to,
+            gossipsub=node.gossipsub,
+            warmup_s=a.warmup_s,
+            self_trigger=node.self_trigger,
+            max_connections=node.max_connections,
+            uses_mix=node.uses_mix,
+            num_mix=node.num_mix,
+            mix_d=node.mix_d,
+        )
+        sim = Simulator(cfg)
     sim.warmup()
     store_dir = a.store_metrics_dir
     if store_dir is None and node.in_shadow:

@@ -304,30 +304,53 @@ class NodeMetrics:
 
     def fill_from_sim(self, sim, peer_id: int) -> None:
         """Project the device-side counters into this node's series — the
-        whole-network process exposes the view of simulated peer `peer_id`."""
+        whole-network process exposes the view of simulated peer `peer_id`.
+
+        Multi-topic sims (runtime/multitopic.py) stack topics as virtual
+        peers: this node's rows are peer_id + t*n_peers, one per topic —
+        per-peer series aggregate over them (a real host's counters sum its
+        topics too), and per-topic gauges get their real topic labels."""
         import numpy as np
 
         st = sim.state
-        mesh_deg = int(np.asarray(st.mesh_mask[peer_id].sum()))
+        multitopic = hasattr(sim, "topic_index")
+        if multitopic:
+            rows = [peer_id + t * sim.n_peers
+                    for t in range(len(sim.cfg.topics))]
+        else:
+            rows = [peer_id]
+        mesh_np = np.asarray(st.mesh_mask)
+        mesh_deg = int(sum(mesh_np[r].sum() for r in rows))
         conns = int(np.asarray((sim.graph.conns[peer_id] >= 0).sum()))
         self.mesh_size.set(mesh_deg, labels=self.labels)
         self.topic_peers.set(conns, labels=self.labels)
         self.peers.set(conns)
         self.pubsub_peers.set(conns)
-        self.pubsub_topics.set(1)
+        self.pubsub_topics.set(len(rows))
         self.open_streams.set(2 * conns)  # one stream per direction, per conn
-        self.mesh_per_topic.set(mesh_deg, labels={"topic": self.topic})
-        self.gossipsub_per_topic.set(conns, labels={"topic": self.topic})
-        self.update_topic_health(mesh_deg, sim.params.d_low)
+        if multitopic:  # one labeled series per topic
+            for name, sz in sim.mesh_sizes().items():
+                self.mesh_per_topic.set(sz, labels={"topic": name})
+                self.gossipsub_per_topic.set(conns, labels={"topic": name})
+            # health judged from this node's WORST topic mesh
+            worst = min(int(mesh_np[r].sum()) for r in rows)
+            self.update_topic_health(worst, sim.params.d_low)
+        else:
+            self.mesh_per_topic.set(mesh_deg, labels={"topic": self.topic})
+            self.gossipsub_per_topic.set(conns, labels={"topic": self.topic})
+            self.update_topic_health(mesh_deg, sim.params.d_low)
+        bytes_tx = np.asarray(st.bytes_tx)
+        bytes_rx = np.asarray(st.bytes_rx)
+        dup = np.asarray(st.dup_rx)
         self.network_bytes.set(
-            float(np.asarray(st.bytes_tx[peer_id])), labels={"direction": "out"})
+            float(sum(bytes_tx[r] for r in rows)), labels={"direction": "out"})
         self.network_bytes.set(
-            float(np.asarray(st.bytes_rx[peer_id])), labels={"direction": "in"})
+            float(sum(bytes_rx[r] for r in rows)), labels={"direction": "in"})
         self.broadcast_graft.set(float(np.asarray(st.grafts)))
         self.received_prune.set(float(np.asarray(st.prunes)))
         self.broadcast_ihave.set(float(np.asarray(st.ihave_tx)))
         self.broadcast_iwant.set(float(np.asarray(st.iwant_tx)))
-        self.duplicates.set(float(np.asarray(st.dup_rx[peer_id])))
+        self.duplicates.set(float(sum(dup[r] for r in rows)))
 
     def render(self) -> str:
         return self.registry.render()

@@ -60,6 +60,8 @@ class MultiTopicConfig:
     warmup_s: float = 60.0
     seed: int = 0
     with_gossip: bool = True
+    max_connections: int = 250       # MAXCONNECTIONS (main.nim:429)
+    self_trigger: bool = True        # SELFTRIGGER (main.nim:245)
 
     def validate(self) -> None:
         self.topo.validate()
@@ -100,7 +102,10 @@ class MultiTopicSimulator:
         n = cfg.topo.network_size
         tcount = len(cfg.topics)
         self.n_peers = n
-        self.graph = build_connection_graph(n, cfg.connect_to, seed=cfg.seed)
+        self.graph = build_connection_graph(
+            n, cfg.connect_to, seed=cfg.seed,
+            max_degree=min(cfg.max_connections, max(4 * cfg.connect_to, 16)),
+        )
         proc_ms = MUXER_PROC_MS.get(cfg.topo.muxer.lower(), 2.0)
         self.params = SimParams.from_gossipsub(
             tcount * n, self.graph.capacity, cfg.gossipsub,
@@ -206,6 +211,8 @@ class MultiTopicSimulator:
             msg_id=int(self._msg_rng.integers(0, 2**63, dtype=np.int64)),
             publisher=publisher,
             t0_ms=t0_ms,
+            # publisher doesn't log its own message when SELFTRIGGER is off
+            drop_self=None if self.cfg.self_trigger else publisher,
         )
         self.records.append((topic, rec))
         return rec

@@ -92,9 +92,20 @@ class NodeService:
         self.sim = simulator
         self.cfg = cfg or NodeConfig()
         self.topic = self.cfg.topic
+        # multi-topic backing sim (runtime/multitopic.py): /publish routes by
+        # the request's topic name; single-topic sims accept only cfg.topic.
+        # ONE flag drives every multi-topic branch (pump dispatch, topic
+        # whitelist, metric labels/aggregation).
+        self._multitopic = hasattr(simulator, "topic_index")
+        self._topics = (tuple(simulator.cfg.topics) if self._multitopic
+                        else (self.topic,))
         self.publishes = PublishQueue()
+        # counters carry one topic label; with several topics the honest
+        # label is the joined list (per-topic mesh gauges are emitted with
+        # their real names separately)
         self.metrics = NodeMetrics(
-            muxer=self.cfg.muxer, peer_id=str(self.cfg.my_id), topic=self.topic)
+            muxer=self.cfg.muxer, peer_id=str(self.cfg.my_id),
+            topic=",".join(self._topics))
         self._metrics_text = self.metrics.render()
         self._lock = threading.Lock()
         self._control_port = control_port
@@ -143,7 +154,7 @@ class NodeService:
                     _json_response(
                         self, 400, {"status": "error", "message": str(e)})
                     return
-                if req.topic != svc.topic:
+                if req.topic not in svc._topics:
                     # "Topic not joined" (main.go:107-110)
                     _text_response(self, 500, "Topic not joined")
                     return
@@ -189,9 +200,10 @@ class NodeService:
 
     def enqueue_publish(self, req: PublishRequest) -> int:
         """Accept a /publish; returns the quantized injection time (ns scale
-        matches the reference's 'published at time <ns>' reply)."""
+        matches the reference's 'published at time <ns>' reply). Metrics are
+        counted at pump() time, when the publish actually succeeds or fails —
+        counting here too would double-book failed requests."""
         self.publishes.put(req)
-        self.metrics.on_publish_request(ok=True)
         t_ms = float(self.sim.state.t_ms)
         return int(t_ms * 1e6)  # ns
 
@@ -205,10 +217,21 @@ class NodeService:
         if advance_ms > 0:
             self.sim.advance(advance_ms)
         n_pub = 0
-        view = self.cfg.my_id % self.sim.params.n  # the simulated peer this
-        # node's metrics report for (my_id can exceed n via PEER_ID_OFFSET)
+        n_real = (self.sim.n_peers if self._multitopic else self.sim.params.n)
+        view = self.cfg.my_id % n_real  # the simulated peer this node's
+        # metrics report for (my_id can exceed n via PEER_ID_OFFSET)
         for req in self.publishes.drain():
-            rec = self.sim.publish(view, msg_size=req.msg_size)
+            try:
+                if self._multitopic:
+                    rec = self.sim.publish(req.topic, view,
+                                           msg_size=req.msg_size)
+                else:
+                    rec = self.sim.publish(view, msg_size=req.msg_size)
+            except ValueError:
+                # e.g. the view peer isn't subscribed to the requested topic
+                self.metrics.on_publish_request(ok=False)
+                continue
+            self.metrics.on_publish_request(ok=True)
             n_pub += 1
             # the stdout contract (main.nim:150): one line per receiver
             for peer, d in zip(rec.receivers, rec.delays_ms_int):

@@ -189,3 +189,50 @@ class TestInjector:
 
         with pytest.raises(ValueError):
             inject(["x"], 100, 1, 0.0, peer_selection="nope")
+
+
+class TestMultiTopicService:
+    """/publish routing by topic name over a multi-topic backing sim
+    (TOPICS env surface of `serve`)."""
+
+    @pytest.fixture(scope="class")
+    def mt_service(self):
+        from dst_libp2p_test_node_tpu.runtime.multitopic import (
+            MultiTopicConfig, MultiTopicSimulator)
+
+        cfg = MultiTopicConfig(
+            topo=TopoParams(network_size=16, msg_size_bytes=400),
+            topics=("blocks", "att"), connect_to=4, warmup_s=5.0, seed=2,
+        )
+        sim = MultiTopicSimulator(cfg)
+        sim.warmup()
+        node = NodeConfig(my_id=2, network_size=16, connect_to=4)
+        svc = NodeService(sim, node, control_port=0, metrics_port=0)
+        svc.start()
+        yield svc
+        svc.stop()
+
+    def test_publish_routes_by_topic(self, mt_service):
+        svc = mt_service
+        for topic in ("blocks", "att"):
+            status, body = _post(
+                f"http://127.0.0.1:{svc.control_port}/publish",
+                {"topic": topic, "msgSize": 400})
+            assert status == 200 and body["status"] == "success"
+        svc.pump()
+        assert [t for t, _ in svc.sim.records] == ["blocks", "att"]
+        assert svc.sim.records[0][1].received.sum() == 16
+
+    def test_unjoined_topic_rejected(self, mt_service):
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(f"http://127.0.0.1:{mt_service.control_port}/publish",
+                  {"topic": "nope", "msgSize": 400})
+        assert e.value.code == 500
+
+    def test_metrics_have_per_topic_series(self, mt_service):
+        svc = mt_service
+        svc.pump()
+        text = svc.metrics_text()
+        assert 'libp2p_pubsub_topics 2' in text
+        assert 'libp2p_gossipsub_peers_per_topic_mesh{topic="blocks"}' in text
+        assert 'libp2p_gossipsub_peers_per_topic_mesh{topic="att"}' in text
