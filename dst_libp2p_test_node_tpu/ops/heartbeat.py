@@ -8,10 +8,12 @@ highest-scored members and at least D_out outbound members), PRUNE backoff
 bookkeeping, and peer-score decay.
 
 Everything is a masked fixed-shape op over the (N, C) neighbor-slot arrays;
-reciprocity (GRAFT/PRUNE control messages) is a single scatter through the
-precomputed reverse-slot map (ops/graph.py). Dead neighbors (churn) simply
-fall out of the validity mask and are replaced on the next rebalance — the
-elastic-recovery analog of the reference's dial-retry loops (SURVEY.md §5).
+reciprocity (GRAFT/PRUNE control messages) is a single row-gather pull
+through the precomputed reverse-slot involution (ops/graph.py, ops/pull.py),
+and the rebalance work runs under lax.cond so a stable mesh skips it
+entirely. Dead neighbors (churn) simply fall out of the validity mask and
+are replaced on the next rebalance — the elastic-recovery analog of the
+reference's dial-retry loops (SURVEY.md §5).
 """
 
 from __future__ import annotations
