@@ -81,7 +81,7 @@ def _next_heartbeat(t, phase, hb_ms):
 @partial(
     jax.jit,
     static_argnames=("params", "payload_bytes", "fragments", "with_gossip",
-                     "mesh"),
+                     "mesh", "with_fanout"),
 )
 def disseminate(
     state: SimState,
@@ -98,6 +98,7 @@ def disseminate(
     with_gossip: bool = True,
     mesh=None,
     loss_stage=None,
+    with_fanout: bool = False,
 ):
     """Propagate one application message (all fragments) through the mesh.
 
@@ -119,13 +120,31 @@ def disseminate(
     turns loss into latency); mesh redundancy then degrades coverage
     gracefully, which is the effect the knob exists to study. Pass None
     (not an all-zero matrix) for the lossless fast path.
+
+    `with_fanout`: the publisher is NOT subscribed to the topic (gossipsub
+    v1.1 fanout publish). It sends to its persistent fanout set — up to D
+    connected topic peers, reused across publishes and topped back up to D
+    at each publish (replenishFanout's effect at the moment it matters),
+    expiring fanout_ttl_ms after the last fanout publish (heartbeat_step
+    drops expired sets). With flood_publish the publisher floods all topic
+    peers as usual, but the fanout set is still maintained, matching
+    nim-libp2p's publish() which updates fanout in the unsubscribed branch
+    regardless of floodPublish. The caller decides with_fanout from the
+    publisher's subscription (host-side; subscription is publish-path
+    static), keeping the subscribed-publisher compile unchanged.
     """
     n, c = conns.shape
+    extra = (1 if loss_stage is not None else 0) + (1 if with_fanout else 0)
+    keys = jax.random.split(state.key, 4 + extra)
+    # positional layout preserves the pre-existing RNG streams bit-exactly
+    # for every previously-compilable configuration
+    key, k_rank, k_gossip, k_phase = keys[0], keys[1], keys[2], keys[3]
+    nxt = 4
     if loss_stage is not None:
-        key, k_rank, k_gossip, k_phase, k_loss = jax.random.split(state.key, 5)
-    else:
-        # lossless runs keep the pre-loss-feature RNG stream bit-identical
-        key, k_rank, k_gossip, k_phase = jax.random.split(state.key, 4)
+        k_loss = keys[nxt]
+        nxt += 1
+    if with_fanout:
+        k_fan = keys[nxt]
 
     frag_bytes = max(payload_bytes // fragments, 16)
     tx_ms = (frag_bytes * 8.0) / (bw_up_mbit_per_stage[stage] * 1e6) * 1e3  # (N,)
@@ -159,9 +178,26 @@ def disseminate(
         survive = jax.random.uniform(k_loss, (n, c)) >= loss_edge
     else:
         survive = None
+    is_pub = jnp.arange(n) == publisher
+    if with_fanout:
+        # fanout set: still-valid unexpired members, topped back up to D
+        # with fresh draws from the remaining connected topic peers. Computed
+        # for every row (shape-static) but only the publisher's row is used
+        # or written back.
+        fan_active = (state.fanout_mask & valid
+                      & (state.fanout_expire[:, None] > t0_ms))
+        need_fan = jnp.maximum(
+            float(params.d) - fan_active.sum(axis=-1).astype(jnp.float32), 0.0)
+        fan_cand = valid & ~fan_active
+        fprio = jnp.where(fan_cand, jax.random.uniform(k_fan, (n, c)), INF)
+        fan_row = fan_active | (
+            fan_cand & (_ranks_f32(fprio) < need_fan[:, None]))
+
     tgt = state.mesh_mask & valid
-    if params.flood_publish:
-        is_pub = jnp.arange(n) == publisher
+    if with_fanout:
+        pub_tgt = valid if params.flood_publish else fan_row
+        tgt = jnp.where(is_pub[:, None], pub_tgt, tgt)
+    elif params.flood_publish:
         tgt = jnp.where(is_pub[:, None], valid, tgt)
 
     # randomized send order per peer (one draw per message, standing in for
@@ -178,6 +214,10 @@ def disseminate(
     hb_phase = jax.random.uniform(k_phase, (n,)) * params.heartbeat_ms
 
     can_send = state.alive & state.subscribed
+    if with_fanout:
+        # the unsubscribed publisher originates (and gossips about) the
+        # message even though it is not a topic member
+        can_send = can_send | (is_pub & state.alive)
 
     def offers(t_rx, rank, k_p, frag_idx, send_mask, deliver_only=False):
         """Arrival-time offers made by every peer on every neighbor slot.
@@ -407,6 +447,17 @@ def disseminate(
         ihave_tx=state.ihave_tx + result.ihave_sent,
         iwant_tx=state.iwant_tx + result.iwant_sent,
     )
+    if with_fanout:
+        # persist the publisher's (possibly replenished) fanout set and
+        # restart its TTL from this publish
+        new_state = new_state.replace(
+            fanout_mask=jnp.where(is_pub[:, None], fan_row, state.fanout_mask),
+            fanout_expire=jnp.where(
+                is_pub,
+                jnp.asarray(t0_ms + params.fanout_ttl_ms, jnp.float32),
+                state.fanout_expire,
+            ),
+        )
     return result, new_state
 
 

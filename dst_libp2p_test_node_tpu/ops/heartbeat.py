@@ -208,8 +208,19 @@ def heartbeat_step(
         state.fmd, state.slow_penalty,
     )
 
+    # -- fanout expiry (v1.1 fanoutTTL): a fanout set whose owner hasn't
+    # fanout-published within the TTL is dropped wholesale (nim-libp2p
+    # dropFanoutPeers). Cond-gated: runs with no fanout publishers skip it.
+    fanout = jax.lax.cond(
+        state.fanout_mask.any(),
+        lambda fm: fm & (t < state.fanout_expire)[:, None],
+        lambda fm: fm,
+        state.fanout_mask,
+    )
+
     return state.replace(
         mesh_mask=mesh,
+        fanout_mask=fanout,
         backoff_until=backoff,
         fmd=fmd,
         slow_penalty=slow,

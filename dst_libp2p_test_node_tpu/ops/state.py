@@ -51,6 +51,7 @@ class SimParams:
     # v1.1 opportunistic grafting (main.nim:292); -10000 = disabled
     opportunistic_graft_threshold: float = -10000.0
     proc_delay_ms: float = 2.0  # per-hop validation/processing latency
+    fanout_ttl_ms: float = 60_000.0  # v1.1 fanoutTTL (libp2p default 60 s)
     max_relax_iters: int = 48   # bound on the earliest-arrival fixpoint
     exclude_first_sender: bool = True   # don't forward back to the delivering peer
     idontwant_threshold_bytes: int = 1000  # go-test-node/main.go:165 (v1.2)
@@ -105,6 +106,8 @@ class SimState:
 
     mesh_mask: jnp.ndarray      # (N, C) bool — GossipSub mesh ⊆ connections
     fanout_mask: jnp.ndarray    # (N, C) bool — fanout set for unsubscribed publishers
+    fanout_expire: jnp.ndarray  # (N,) float32 ms — when each fanout set expires
+    #                             (last fanout publish + fanout_ttl_ms; 0 = none)
     backoff_until: jnp.ndarray  # (N, C) float32 ms — PRUNE backoff per directed edge
     fmd: jnp.ndarray            # (N, C) float32 — firstMessageDeliveries counter
     slow_penalty: jnp.ndarray   # (N, C) float32 — slowPeerPenalty COUNTER
@@ -139,6 +142,7 @@ def init_state(params: SimParams, seed: int = 0) -> SimState:
     return SimState(
         mesh_mask=jnp.zeros((n, c), dtype=bool),
         fanout_mask=jnp.zeros((n, c), dtype=bool),
+        fanout_expire=jnp.zeros((n,), dtype=jnp.float32),
         backoff_until=jnp.zeros((n, c), dtype=jnp.float32),
         fmd=jnp.zeros((n, c), dtype=jnp.float32),
         slow_penalty=jnp.zeros((n, c), dtype=jnp.float32),

@@ -130,6 +130,8 @@ def load_checkpoint(path: str, mesh=None) -> Simulator:
         k.split("/", 1)[1]: z[k] for k in z.files if k.startswith("state/")
     }
     sim.state = serialization.from_state_dict(sim.state, state_dict)
+    # the publish-path fanout decision reads a host mirror of subscription
+    sim._subscribed_np = np.asarray(sim.state.subscribed).copy()
     if mesh is not None:
         # from_state_dict replaced the constructor's sharded leaves with host
         # arrays; re-place them row-sharded (graph/topology arrays were

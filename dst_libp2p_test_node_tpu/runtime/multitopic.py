@@ -126,6 +126,13 @@ class MultiTopicSimulator:
         self._stage = jnp.asarray(np.tile(self.topology.stage_of_peer, tcount))
         self._lat = jnp.asarray(self.topology.latency_ms)
         self._bw = jnp.asarray(self.topology.bw_up_mbit)
+        # per-stage-pair packet loss (topogen -l): the tiled stage array
+        # already indexes the (S+1, S+1) matrix, so no tiling is needed;
+        # None keeps the lossless fast path out of the compiled step
+        self._loss = (
+            jnp.asarray(self.topology.packet_loss)
+            if float(np.max(self.topology.packet_loss)) > 0.0 else None
+        )
 
         rng = np.random.default_rng(np.random.SeedSequence([cfg.seed, 0x709]))
         self.subscribed_np = np.ones((tcount, n), dtype=bool)
@@ -175,16 +182,10 @@ class MultiTopicSimulator:
         """One message on one topic; dissemination stays inside the topic's
         block of the stacked graph by construction.
 
-        The publisher must be subscribed: an unsubscribed peer's offers are
-        all masked and the message silently reaches nobody, so we fail fast
-        instead (the reference's unsubscribed-publish path — fanout — is a
-        publish-time peer set the engine does not model yet)."""
+        A publisher not subscribed to the topic goes through the gossipsub
+        v1.1 fanout path (disseminate with_fanout): it sends to a persistent
+        fanout set of up to D topic peers with fanout-TTL expiry."""
         ti = self.topic_index(topic)
-        if not self.subscribed_np[ti][publisher]:
-            raise ValueError(
-                f"peer {publisher} is not subscribed to {topic!r}; "
-                "fanout publish is not modeled — pick a subscriber"
-            )
         size = msg_size if msg_size is not None else self.cfg.topo.msg_size_bytes
         a = self.arrays
         n = self.n_peers
@@ -195,6 +196,8 @@ class MultiTopicSimulator:
             params=self.params, payload_bytes=size,
             fragments=self.cfg.topo.num_frags,
             with_gossip=self.cfg.with_gossip,
+            loss_stage=self._loss,
+            with_fanout=not bool(self.subscribed_np[ti][publisher]),
         )
         blk = slice(ti * n, (ti + 1) * n)
 
@@ -211,8 +214,13 @@ class MultiTopicSimulator:
             msg_id=int(self._msg_rng.integers(0, 2**63, dtype=np.int64)),
             publisher=publisher,
             t0_ms=t0_ms,
-            # publisher doesn't log its own message when SELFTRIGGER is off
-            drop_self=None if self.cfg.self_trigger else publisher,
+            # the publisher doesn't log its own message when SELFTRIGGER is
+            # off, and never when unsubscribed (no topic handler to fire —
+            # the fanout-publish case)
+            drop_self=publisher
+            if (not self.cfg.self_trigger
+                or not self.subscribed_np[ti][publisher])
+            else None,
         )
         self.records.append((topic, rec))
         return rec

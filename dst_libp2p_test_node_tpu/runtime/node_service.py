@@ -34,6 +34,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from ..config.env import HTTP_CONTROL_PORT, PROMETHEUS_PORT, NodeConfig
 from .metrics import NodeMetrics
+from .simulator import MixDegradedError
 
 
 @dataclass
@@ -227,8 +228,13 @@ class NodeService:
                                            msg_size=req.msg_size)
                 else:
                     rec = self.sim.publish(view, msg_size=req.msg_size)
-            except ValueError:
-                # e.g. the view peer isn't subscribed to the requested topic
+            except (ValueError, MixDegradedError):
+                # bad request parameters or a degraded mix network. (A view
+                # peer not subscribed to the topic is NOT an error: it
+                # publishes through the gossipsub v1.1 fanout path. Engine/
+                # runtime failures like XlaRuntimeError propagate — a dead
+                # device must crash the service, not count as failed
+                # publishes.)
                 self.metrics.on_publish_request(ok=False)
                 continue
             self.metrics.on_publish_request(ok=True)

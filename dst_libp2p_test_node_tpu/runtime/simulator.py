@@ -41,6 +41,12 @@ MUXER_PROC_MS = {"yamux": 2.0, "mplex": 2.2, "quic": 1.5}
 _INF_CUTOFF = 1e30
 
 
+class MixDegradedError(RuntimeError):
+    """The mix network has fewer eligible nodes than MIXD (a publish-time
+    condition, not an engine failure — the service layer counts it as a
+    failed publish request and keeps serving)."""
+
+
 @dataclass
 class ExperimentConfig:
     topo: TopoParams = field(default_factory=TopoParams)
@@ -80,15 +86,16 @@ def drain_heartbeat_carry(carry_ms: float, ms: float, hb_ms: float):
 
 def record_from_result(
     res, *, msg_id: int, publisher: int, t0_ms: float,
-    extra_delay_ms: float = 0.0, drop_self: int | None = None,
+    extra_delay_ms: float = 0.0, drop_self=None,
 ) -> "MessageRecord":
     """Build a MessageRecord from a DisseminationResult (shared by the
-    single-topic and multi-topic publish paths). `drop_self`: peer whose own
-    delivery is suppressed (SELFTRIGGER off, main.nim:245)."""
+    single-topic and multi-topic publish paths). `drop_self`: peer id (or
+    list of ids) whose own delivery is suppressed (SELFTRIGGER off,
+    main.nim:245; unsubscribed originators/exit nodes with no handler)."""
     delays = np.asarray(res.delay_ms, dtype=np.float64) + extra_delay_ms
     received = np.asarray(res.received).copy()
     if drop_self is not None:
-        received[drop_self] = False
+        received[np.asarray(drop_self)] = False
     delays = np.where(received, delays, np.inf)
     return MessageRecord(
         msg_id=msg_id,
@@ -192,6 +199,10 @@ class Simulator:
                 topo_arrs["stage"], topo_arrs["lat"], topo_arrs["bw"]
             )
             self._loss = topo_arrs.get("loss")
+        # host mirror of state.subscribed: publish() picks the fanout code
+        # path (static arg) without a device sync; keep in sync via
+        # set_subscribed()
+        self._subscribed_np = np.ones(n, dtype=bool)
         self._msg_rng = np.random.default_rng(cfg.seed ^ 0x6D736749)  # msgId stream
         self._last_msg_id = -1  # go-mode monotonic timestamp tie-break
         self._hb_carry_ms = 0.0
@@ -204,6 +215,26 @@ class Simulator:
             self.mix_params.validate()
 
     # ---------------------------------------------------------------- phases
+
+    def set_subscribed(self, mask) -> None:
+        """Set per-peer topic membership. An unsubscribed peer can still
+        publish — it goes through the gossipsub v1.1 fanout path
+        (disseminate with_fanout)."""
+        import jax.numpy as jnp
+
+        mask = np.asarray(mask, dtype=bool)
+        if mask.shape != (self.params.n,):
+            raise ValueError(f"subscribed mask must be ({self.params.n},)")
+        self._subscribed_np = mask
+        sub = jnp.asarray(mask)
+        if self.mesh is not None:
+            # keep the leaf row-sharded like the rest of the state pytree
+            import jax
+
+            from ..parallel.sharding import peer_sharding
+
+            sub = jax.device_put(sub, peer_sharding(self.mesh))
+        self.state = self.state.replace(subscribed=sub)
 
     def advance(self, ms: float) -> None:
         """Advance simulated time by `ms`, running the heartbeats due."""
@@ -239,7 +270,7 @@ class Simulator:
                 self.params.n, self.mix_params.num_mix,
             )
             if eligible < self.mix_params.mix_d:
-                raise RuntimeError(
+                raise MixDegradedError(
                     f"mix network degraded: {eligible} eligible mix nodes "
                     f"(alive, mounted, != publisher) < MIXD={self.mix_params.mix_d}"
                 )
@@ -284,6 +315,8 @@ class Simulator:
             with_gossip=cfg.with_gossip,
             mesh=self.mesh,
             loss_stage=self._loss,
+            # unsubscribed publisher -> gossipsub v1.1 fanout publish
+            with_fanout=not bool(self._subscribed_np[publisher]),
         )
         if cfg.msgid_mode == "go":
             # Go/Rust key messages by the embedded LE64 ns timestamp. The
@@ -301,8 +334,15 @@ class Simulator:
             publisher=origin,
             t0_ms=t0_ms,
             extra_delay_ms=mix_delay,
-            # publisher doesn't log its own message when SELFTRIGGER is off
-            drop_self=None if cfg.self_trigger else origin,
+            # a peer doesn't log its own message when SELFTRIGGER is off, and
+            # never when unsubscribed (no topic handler to fire): the origin
+            # on the fanout path, and a mix exit node publishing on the
+            # origin's behalf while itself unsubscribed
+            drop_self=[
+                p for p in {origin, publisher}
+                if (p == origin and not cfg.self_trigger)
+                or not self._subscribed_np[p]
+            ] or None,
         )
         self.records.append(rec)
         return rec

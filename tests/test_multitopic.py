@@ -94,10 +94,13 @@ def test_config_validation():
         MultiTopicConfig(subscribe_fraction=0.0).validate()
 
 
-def test_unsubscribed_publisher_rejected():
+def test_unsubscribed_publisher_uses_fanout():
+    # round 1 rejected unsubscribed publishers; they now publish through the
+    # gossipsub v1.1 fanout path (tests/test_fanout.py covers the semantics)
     cfg = _cfg(topics=("a",), subscribe_fraction=0.5, seed=9)
     s = MultiTopicSimulator(cfg)
     s.warmup()
     unsub = int(np.nonzero(~s.subscribed_np[0])[0][0])
-    with pytest.raises(ValueError, match="not subscribed"):
-        s.publish("a", publisher=unsub)
+    rec = s.publish("a", publisher=unsub)
+    assert rec.received[s.subscribed_np[0]].mean() > 0.5
+    assert not rec.received[unsub]
