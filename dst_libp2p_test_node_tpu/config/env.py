@@ -111,6 +111,11 @@ class GossipSubParams:
     flood_publish: bool = True
     opportunistic_graft_threshold: float = -10000.0
     gossip_factor: float = 0.25
+    # mcache gossip window: IHAVE re-samples targets every heartbeat for this
+    # many rounds after a message enters the cache (nim-libp2p
+    # GossipSubHistoryGossip default; gossip every heartbeat over history,
+    # main.nim:259,283)
+    history_gossip: int = 3
 
     # topicParams (main.nim:335-340)
     topic_weight: float = 1.0
@@ -138,6 +143,9 @@ class GossipSubParams:
             )
         if self.heartbeat_ms <= 0:
             raise ValueError("heartbeat_ms must be positive")
+        if self.history_gossip < 1:
+            raise ValueError(
+                f"history_gossip must be >= 1, got {self.history_gossip}")
 
 
 def gossipsub_params_from_env() -> GossipSubParams:
@@ -163,6 +171,7 @@ def gossipsub_params_from_env() -> GossipSubParams:
         flood_publish=env_bool("GOSSIPSUB_FLOOD_PUBLISH", True),
         opportunistic_graft_threshold=env_float("GOSSIPSUB_OPPORTUNISTIC_GRAFT_THRESHOLD", -10000.0),
         gossip_factor=env_float("GOSSIPSUB_GOSSIP_FACTOR", 0.25),
+        history_gossip=env_int("GOSSIPSUB_HISTORY_GOSSIP", 3),
         idontwant_message_threshold=env_int("GOSSIPSUB_IDONTWANT_THRESHOLD", 1000),
     )
     p.validate()

@@ -64,8 +64,8 @@ class DisseminationResult:
     received: jnp.ndarray      # (N,) bool (all fragments)
     sends: jnp.ndarray         # (N,) int32 message copies sent by each peer
     copies_rx: jnp.ndarray     # (N,) int32 copies received (>=1 => received)
-    ihave_sent: jnp.ndarray    # () int32
-    iwant_sent: jnp.ndarray    # () int32
+    ihave_sent: jnp.ndarray    # (N,) int32 IHAVEs sent per peer
+    iwant_sent: jnp.ndarray    # (N,) int32 IWANTs sent per peer
 
 
 def _ranks_f32(priority: jnp.ndarray) -> jnp.ndarray:
@@ -135,11 +135,9 @@ def disseminate(
     """
     n, c = conns.shape
     extra = (1 if loss_stage is not None else 0) + (1 if with_fanout else 0)
-    keys = jax.random.split(state.key, 4 + extra)
-    # positional layout preserves the pre-existing RNG streams bit-exactly
-    # for every previously-compilable configuration
-    key, k_rank, k_gossip, k_phase = keys[0], keys[1], keys[2], keys[3]
-    nxt = 4
+    keys = jax.random.split(state.key, 3 + extra)
+    key, k_rank, k_gossip = keys[0], keys[1], keys[2]
+    nxt = 3
     if loss_stage is not None:
         k_loss = keys[nxt]
         nxt += 1
@@ -205,13 +203,35 @@ def disseminate(
     rprio = jnp.where(tgt, jax.random.uniform(k_rank, (n, c)), INF)
 
     # gossip edge sampling: non-mesh connected topic peers; count =
-    # max(D_lazy, gossip_factor * |candidates|)  (v1.1 heartbeat gossip)
+    # max(D_lazy, gossip_factor * |candidates|)  (v1.1 heartbeat gossip).
+    # The reference gossips EVERY heartbeat over the mcache history window
+    # (history_gossip rounds, main.nim:259,283): each tick draws a FRESH
+    # sample, so a peer missed in round h can be reached in round h+1 —
+    # that re-sampling is what drives gossip recovery under loss/churn.
     g_cand = valid & ~tgt
     n_gc = g_cand.sum(axis=-1).astype(jnp.float32)
     g_count = jnp.maximum(float(params.d_lazy), params.gossip_factor * n_gc)
-    gprio = jnp.where(g_cand, jax.random.uniform(k_gossip, (n, c)), INF)
-    g_tgt = g_cand & (_ranks_f32(gprio) < g_count[:, None])
-    hb_phase = jax.random.uniform(k_phase, (n,)) * params.heartbeat_ms
+    n_rounds = params.history_gossip if with_gossip else 1
+    gkeys = jax.random.split(k_gossip, n_rounds)
+    g_tgt_w = jnp.stack([
+        g_cand & (_ranks_f32(
+            jnp.where(g_cand, jax.random.uniform(gkeys[h], (n, c)), INF)
+        ) < g_count[:, None])
+        for h in range(n_rounds)
+    ])                                                  # (W, N, C)
+    g_tgt = g_tgt_w.any(axis=0)
+    # round offsets grow by a heartbeat each, so only the FIRST round an edge
+    # is sampled can be its min offer — the multi-round term collapses to a
+    # single (N, C) per-edge heartbeat offset inside the fixpoint (the full
+    # per-round sets are still used for IHAVE/IWANT accounting below)
+    g_off = jnp.min(
+        jnp.where(g_tgt_w,
+                  jnp.arange(n_rounds, dtype=jnp.float32)[:, None, None],
+                  jnp.float32(n_rounds)),
+        axis=0) * params.heartbeat_ms
+    # heartbeat phase is a persistent per-NODE property (drawn once per run in
+    # init_state), so gossip-arrival timing is consistent across messages
+    hb_phase = state.hb_phase
 
     can_send = state.alive & state.subscribed
     if with_fanout:
@@ -219,16 +239,23 @@ def disseminate(
         # message even though it is not a topic member
         can_send = can_send | (is_pub & state.alive)
 
+    # cross-message bandwidth contention: a sender's queue for THIS message
+    # starts no earlier than the time its uplink drains traffic of earlier
+    # messages (state write-back below; reference per-connection queues
+    # serialize all in-flight traffic, main.nim:264-299)
+    uplink = state.uplink_free_ms
+
     def offers(t_rx, rank, k_p, frag_idx, send_mask, deliver_only=False):
         """Arrival-time offers made by every peer on every neighbor slot.
         `deliver_only`: additionally mask copies the network loses — use for
         anything receiver-side (first-sender detection, delivery pulls);
         leave False for transmit-side accounting (sends, tx bytes)."""
         base = t_rx + params.proc_delay_ms
+        start = jnp.maximum(base, uplink)
         # uplink serialization: (rank+1) sends of this fragment, plus the
         # frag_idx earlier fragments each occupying k_p uplink slots
         queue = (rank + 1.0 + frag_idx * k_p[:, None]) * tx_ms[:, None]
-        cand = base[:, None] + queue + lat_edge
+        cand = start[:, None] + queue + lat_edge
         live = can_send[:, None] & (t_rx[:, None] < INF)
         sm = send_mask
         gm = g_tgt
@@ -238,7 +265,8 @@ def disseminate(
         cand = jnp.where(sm & live, cand, INF)
         if with_gossip:
             hb = _next_heartbeat(base, hb_phase, params.heartbeat_ms)
-            g = hb[:, None] + 3.0 * lat_edge + tx_ms[:, None]
+            g = jnp.maximum(hb[:, None] + g_off, uplink[:, None]) \
+                + 3.0 * lat_edge + tx_ms[:, None]
             cand = jnp.minimum(cand, jnp.where(gm & live, g, INF))
         return cand
 
@@ -266,8 +294,8 @@ def disseminate(
             # psum per iteration over ICI (parallel/exchange.py)
             c = build_recv_constants(
                 conns, rev, lat_edge, tx_ms, rank, k_p, frag_idx, deliver,
-                can_send, g_deliver, hb_phase, params.proc_delay_ms,
-                params.heartbeat_ms, with_gossip,
+                can_send, g_deliver, g_off, hb_phase, uplink,
+                params.proc_delay_ms, params.heartbeat_ms, with_gossip,
             )
             return converge_sharded(t0, c, params.max_relax_iters, mesh)
         # single device: sender-major offers (loop-invariant parts hoisted
@@ -275,8 +303,7 @@ def disseminate(
         # speed of a receiver-side index gather (ops/pull.py)
         queue = (rank + 1.0 + frag_idx * k_p[:, None]) * tx_ms[:, None]
         a_base = jnp.where(
-            deliver & can_send[:, None],
-            params.proc_delay_ms + queue + lat_edge, INF)
+            deliver & can_send[:, None], queue + lat_edge, INF)
         g_base = jnp.where(
             g_deliver & can_send[:, None],
             3.0 * lat_edge + tx_ms[:, None], INF)
@@ -288,12 +315,16 @@ def disseminate(
         def body(carry):
             t_rx, _, it = carry
             live = (t_rx < INF)[:, None]
-            cand = jnp.where(live, t_rx[:, None] + a_base, INF)
+            base = t_rx + params.proc_delay_ms
+            start = jnp.maximum(base, uplink)
+            cand = jnp.where(live, start[:, None] + a_base, INF)
             if with_gossip:
-                hb = _next_heartbeat(
-                    t_rx + params.proc_delay_ms, hb_phase, params.heartbeat_ms)
+                hb = _next_heartbeat(base, hb_phase, params.heartbeat_ms)
                 cand = jnp.minimum(
-                    cand, jnp.where(live, hb[:, None] + g_base, INF))
+                    cand,
+                    jnp.where(live,
+                              jnp.maximum(hb[:, None] + g_off,
+                                          uplink[:, None]) + g_base, INF))
             t_new = jnp.minimum(t_rx, pull(cand).min(axis=-1))
             return t_new, jnp.any(t_new < t_rx), it + 1
 
@@ -368,28 +399,55 @@ def disseminate(
             t_rx_one, conns, rev, batch_factor=fragments)
         # IDONTWANT (v1.2): target announced receipt before our send began
         if payload_bytes >= params.idontwant_threshold_bytes:
-            send_start = t_rx_one[:, None] + params.proc_delay_ms + (
-                rank + frag_idx * k_p[:, None]
-            ) * tx_ms[:, None]
+            send_start = jnp.maximum(
+                t_rx_one + params.proc_delay_ms, uplink
+            )[:, None] + (rank + frag_idx * k_p[:, None]) * tx_ms[:, None]
             idw_arrived = q_t + lat_edge < send_start
             made_offer = made_offer & ~(idw_arrived & send_mask)
         sends = (made_offer & send_mask).sum(axis=-1)
         if with_gossip:
             havers = (t_rx_one < INF) & can_send
-            ihave = (g_tgt & havers[:, None]).sum()
             hb = _next_heartbeat(
                 t_rx_one + params.proc_delay_ms, hb_phase, params.heartbeat_ms
             )
-            # fill on invalid slots is irrelevant: `lacked` is ANDed with
-            # g_tgt (a subset of valid edges) below
-            lacked = q_t > hb[:, None] + lat_edge
-            gossip_sent = g_tgt & havers[:, None] & lacked
-            iwant = gossip_sent.sum()
+            # per-round accounting over the mcache window: every heartbeat
+            # tick h the emitter IHAVEs its fresh sample; the receiver IWANTs
+            # only if it still lacks the message when the announce lands.
+            # `lacked` fill on invalid slots is irrelevant: it is ANDed with
+            # per-round sets that are subsets of valid edges.
+            ihave_ct = jnp.zeros((n, c), jnp.float32)   # per-edge IHAVEs
+            gossip_sent = jnp.zeros((n, c), bool)       # edge answered an IWANT
+            for h in range(n_rounds):
+                active_h = g_tgt_w[h] & havers[:, None]
+                ihave_ct = ihave_ct + active_h
+                # the announce leaves when the tick fires AND the sender's
+                # uplink has drained — same clamp the fixpoint applies
+                lacked_h = q_t > jnp.maximum(
+                    hb[:, None] + h * params.heartbeat_ms, uplink[:, None]
+                ) + lat_edge
+                gossip_sent = gossip_sent | (active_h & lacked_h)
+            ihave_pp = ihave_ct.sum(axis=-1)            # (N,) IHAVEs sent
+            # IHAVEs received: pull the per-edge counts through the involution
+            slot_ok = (conns >= 0) & (rev >= 0)
+            ihave_rx_pp = jnp.where(
+                slot_ok,
+                reciprocal_pull_min(ihave_ct, conns, rev,
+                                    batch_factor=fragments),
+                0.0,
+            ).sum(axis=-1)
+            # the IWANT flows opposite the IHAVE: the lacking RECEIVER sends
+            # it, the gossiping peer receives it
+            iwant_rx_pp = gossip_sent.sum(axis=-1).astype(jnp.float32)
+            iwant_pp = reciprocal_pull_bool(
+                gossip_sent, conns, rev, batch_factor=fragments
+            ).sum(axis=-1).astype(jnp.float32)
             sends = sends + (gossip_sent & made_offer).sum(axis=-1)
             sent_any = (made_offer & send_mask) | (gossip_sent & made_offer)
         else:
-            ihave = jnp.int32(0)
-            iwant = jnp.int32(0)
+            ihave_pp = jnp.zeros((n,), jnp.float32)
+            iwant_pp = jnp.zeros((n,), jnp.float32)
+            ihave_rx_pp = jnp.zeros((n,), jnp.float32)
+            iwant_rx_pp = jnp.zeros((n,), jnp.float32)
             sent_any = made_offer & send_mask
         # receivers only count copies the network actually delivered
         arrived = sent_any if survive is None else sent_any & survive
@@ -401,7 +459,11 @@ def disseminate(
         # opportunistic grafting then route around low-bandwidth peers.
         # Weight 0 (the default) statically removes the computation.
         if params.slow_weight != 0.0:
-            qdelay = (rank + frag_idx * k_p[:, None]) * tx_ms[:, None]
+            # queue delay as the receiver experiences it: the wait for the
+            # sender's uplink to drain earlier traffic counts too
+            qdelay = jnp.maximum(
+                uplink - (t_rx_one + params.proc_delay_ms), 0.0
+            )[:, None] + (rank + frag_idx * k_p[:, None]) * tx_ms[:, None]
             slow_send = send_mask & made_offer & (
                 qdelay > params.slow_threshold_ms)
             slow_inc = reciprocal_pull_bool(
@@ -409,13 +471,19 @@ def disseminate(
             ).astype(jnp.float32)
         else:
             slow_inc = jnp.zeros((n, c), jnp.float32)
-        return sends, copies, ihave, iwant, first_slot, slow_inc
+        return (sends, copies, ihave_pp, iwant_pp, ihave_rx_pp, iwant_rx_pp,
+                first_slot, slow_inc)
 
-    (sends_f, copies_f, ihave_f, iwant_f, first_slot_f, slow_f) = jax.vmap(
+    (sends_f, copies_f, ihave_f, iwant_f, ihave_rx_f, iwant_rx_f,
+     first_slot_f, slow_f) = jax.vmap(
         frag_accounting
     )(frag_ids, t_rx_f, rank_f, k_f, smask_f)
     sends = sends_f.sum(axis=0).astype(jnp.int32)
     copies = copies_f.sum(axis=0).astype(jnp.int32)
+    ihave_pp = ihave_f.sum(axis=0).astype(jnp.int32)
+    iwant_pp = iwant_f.sum(axis=0).astype(jnp.int32)
+    ihave_rx_pp = ihave_rx_f.sum(axis=0).astype(jnp.int32)
+    iwant_rx_pp = iwant_rx_f.sum(axis=0).astype(jnp.int32)
 
     # firstMessageDeliveries: credit the edge that delivered fragment 0 first
     fs = first_slot_f[0]
@@ -431,21 +499,33 @@ def disseminate(
         received=received,
         sends=sends,
         copies_rx=copies,
-        ihave_sent=ihave_f.sum().astype(jnp.int32),
-        iwant_sent=iwant_f.sum().astype(jnp.int32),
+        ihave_sent=ihave_pp,
+        iwant_sent=iwant_pp,
     )
     dup = jnp.maximum(copies - fragments, 0)
+    # uplink occupancy write-back: fragment f's last send finishes
+    # (f+1)*k_f serialization slots after its start (the queue model above);
+    # the max over fragments is when the sender's uplink drains. Carried in
+    # SimState so the NEXT message's sends queue behind this one.
+    sent_f = (k_f > 0) & (t_rx_f < INF) & can_send[None, :]
+    start_f = jnp.maximum(t_rx_f + params.proc_delay_ms, uplink[None, :])
+    end_f = start_f + (frag_ids + 1.0)[:, None] * k_f * tx_ms[None, :]
+    uplink_new = jnp.maximum(
+        uplink, jnp.where(sent_f, end_f, 0.0).max(axis=0))
     # the counter accrues unweighted; score() applies the (negative) weight
     slow_penalty = state.slow_penalty + slow_f.sum(axis=0)
     new_state = state.replace(
         key=key,
+        uplink_free_ms=uplink_new,
         fmd=fmd,
         slow_penalty=slow_penalty,
         bytes_tx=state.bytes_tx + sends.astype(jnp.float32) * frag_bytes,
         bytes_rx=state.bytes_rx + copies.astype(jnp.float32) * frag_bytes,
         dup_rx=state.dup_rx + dup.astype(jnp.int32),
-        ihave_tx=state.ihave_tx + result.ihave_sent,
-        iwant_tx=state.iwant_tx + result.iwant_sent,
+        ihave_tx=state.ihave_tx + ihave_pp,
+        iwant_tx=state.iwant_tx + iwant_pp,
+        ihave_rx=state.ihave_rx + ihave_rx_pp,
+        iwant_rx=state.iwant_rx + iwant_rx_pp,
     )
     if with_fanout:
         # persist the publisher's (possibly replenished) fanout set and

@@ -35,6 +35,7 @@ class SimParams:
     heartbeat_ms: float = 1000.0
     prune_backoff_ms: float = 60_000.0
     gossip_factor: float = 0.25
+    history_gossip: int = 3     # mcache gossip window in heartbeats
     flood_publish: bool = True
     fmd_weight: float = 1.0     # firstMessageDeliveries topic params (main.nim:335-340)
     fmd_cap: float = 30.0
@@ -68,6 +69,9 @@ class SimParams:
             raise ValueError("need at least 2 peers")
         if self.heartbeat_ms <= 0:
             raise ValueError("heartbeat_ms must be positive")
+        if self.history_gossip < 1:
+            raise ValueError(
+                f"history_gossip must be >= 1, got {self.history_gossip}")
 
     @classmethod
     def from_gossipsub(
@@ -85,6 +89,7 @@ class SimParams:
             heartbeat_ms=float(g.heartbeat_ms),
             prune_backoff_ms=float(g.prune_backoff_sec) * 1000.0,
             gossip_factor=g.gossip_factor,
+            history_gossip=g.history_gossip,
             flood_publish=g.flood_publish,
             fmd_weight=g.first_message_deliveries_weight,
             fmd_cap=g.first_message_deliveries_cap,
@@ -114,6 +119,21 @@ class SimState:
     #                             (non-negative; weighted only in score())
     alive: jnp.ndarray          # (N,) bool — churn mask
     subscribed: jnp.ndarray     # (N,) bool — topic membership
+    hb_phase: jnp.ndarray       # (N,) float32 ms — per-peer heartbeat phase.
+    #                             Nodes start at different wall times, so ticks
+    #                             are unaligned; the phase is a property of the
+    #                             NODE (drawn once per run), not of a message —
+    #                             gossip-arrival timing is consistent across
+    #                             messages the way a real node's timer is.
+    uplink_free_ms: jnp.ndarray  # (N,) float32 ms — absolute time each peer's
+    #                             uplink drains. The reference's per-connection
+    #                             queues serialize ALL in-flight traffic
+    #                             (main.nim:264-299): a second message published
+    #                             while the first is still forwarding queues
+    #                             behind it. disseminate() starts each sender at
+    #                             max(t_rx + proc, uplink_free) and writes back
+    #                             the final occupancy, coupling concurrent
+    #                             messages the way shared uplinks do.
     t_ms: jnp.ndarray           # () float32 — sim clock
     key: jnp.ndarray            # jax PRNG key
     # cumulative observability counters (reference L5)
@@ -122,8 +142,13 @@ class SimState:
     bytes_tx: jnp.ndarray       # (N,) float32
     bytes_rx: jnp.ndarray       # (N,) float32
     dup_rx: jnp.ndarray         # (N,) int32
-    ihave_tx: jnp.ndarray      # () int64-ish int32
-    iwant_tx: jnp.ndarray      # () int32
+    # per-peer gossip control-message counters, both directions — the
+    # shadowlog's per-node ctrl fields are real per-node counters
+    # (summary_shadowlog.awk:3-8), so these are (N,)-shaped, not globals
+    ihave_tx: jnp.ndarray      # (N,) int32 IHAVE announcements sent
+    iwant_tx: jnp.ndarray      # (N,) int32 IWANT requests sent
+    ihave_rx: jnp.ndarray      # (N,) int32 IHAVE announcements received
+    iwant_rx: jnp.ndarray      # (N,) int32 IWANT requests received
 
     def score(self, params: SimParams) -> jnp.ndarray:
         """Peer score as seen across each directed edge (v1.1 subset:
@@ -139,6 +164,8 @@ def init_state(params: SimParams, seed: int = 0) -> SimState:
 
     params.validate()
     n, c = params.n, params.capacity
+    key = jax.random.PRNGKey(seed)
+    key, k_phase = jax.random.split(key)
     return SimState(
         mesh_mask=jnp.zeros((n, c), dtype=bool),
         fanout_mask=jnp.zeros((n, c), dtype=bool),
@@ -148,15 +175,19 @@ def init_state(params: SimParams, seed: int = 0) -> SimState:
         slow_penalty=jnp.zeros((n, c), dtype=jnp.float32),
         alive=jnp.ones((n,), dtype=bool),
         subscribed=jnp.ones((n,), dtype=bool),
+        hb_phase=jax.random.uniform(k_phase, (n,)) * params.heartbeat_ms,
+        uplink_free_ms=jnp.zeros((n,), dtype=jnp.float32),
         t_ms=jnp.asarray(0.0, dtype=jnp.float32),
-        key=jax.random.PRNGKey(seed),
+        key=key,
         grafts=jnp.asarray(0, dtype=jnp.int32),
         prunes=jnp.asarray(0, dtype=jnp.int32),
         bytes_tx=jnp.zeros((n,), dtype=jnp.float32),
         bytes_rx=jnp.zeros((n,), dtype=jnp.float32),
         dup_rx=jnp.zeros((n,), dtype=jnp.int32),
-        ihave_tx=jnp.asarray(0, dtype=jnp.int32),
-        iwant_tx=jnp.asarray(0, dtype=jnp.int32),
+        ihave_tx=jnp.zeros((n,), dtype=jnp.int32),
+        iwant_tx=jnp.zeros((n,), dtype=jnp.int32),
+        ihave_rx=jnp.zeros((n,), dtype=jnp.int32),
+        iwant_rx=jnp.zeros((n,), dtype=jnp.int32),
     )
 
 

@@ -47,10 +47,17 @@ class RecvConstants:
 
     src: jnp.ndarray        # (N, C) int32 sender peer id (conns), -1 pad
     a_ms: jnp.ndarray       # (N, C) float32 mesh-edge additive constant
+    #                         (queue slot + latency; proc applies to the start)
     mesh_ok: jnp.ndarray    # (N, C) bool mesh edge active
     g_ms: jnp.ndarray       # (N, C) float32 gossip additive constant
     g_ok: jnp.ndarray       # (N, C) bool gossip edge active
+    g_off: jnp.ndarray      # (N, C) float32 gossip-round heartbeat offset:
+    #                         the mcache window re-samples IHAVE targets each
+    #                         heartbeat; this is (first round sampled) * hb_ms
     phase: jnp.ndarray      # (N, C) float32 sender heartbeat phase
+    u_ms: jnp.ndarray       # (N, C) float32 sender uplink-free time: sends
+    #                         start no earlier than this (cross-message
+    #                         bandwidth contention, ops/state.py uplink_free_ms)
     proc_ms: jnp.ndarray    # () float32
     hb_ms: jnp.ndarray      # () float32
 
@@ -71,8 +78,10 @@ def build_recv_constants(
     frag_idx,
     send_mask: jnp.ndarray,     # (N, C) sender-side forwarding mask
     can_send: jnp.ndarray,      # (N,) alive & subscribed
-    g_tgt: jnp.ndarray,         # (N, C) sender-side gossip targets
+    g_tgt: jnp.ndarray,         # (N, C) sender-side gossip targets (any round)
+    g_off_s: jnp.ndarray,       # (N, C) sender-side gossip-round offset (ms)
     hb_phase: jnp.ndarray,      # (N,) heartbeat phase
+    uplink_free: jnp.ndarray,   # (N,) sender uplink-free time (absolute ms)
     proc_ms: float,
     hb_ms: float,
     with_gossip: bool,
@@ -81,7 +90,7 @@ def build_recv_constants(
     reverse-slot map once, leaving a fixpoint that touches only t_rx."""
     valid = (conns >= 0) & (rev >= 0)
     queue = (rank + 1.0 + frag_idx * k_p[:, None]) * tx_ms[:, None]
-    a_sender = proc_ms + queue + lat_edge              # offers minus t_rx
+    a_sender = queue + lat_edge     # offers minus the send start
     a_ms = jnp.where(valid, _edge_gather(a_sender, conns, rev), INF)
     mesh_ok = valid & _edge_gather(
         send_mask & can_send[:, None], conns, rev)
@@ -90,18 +99,24 @@ def build_recv_constants(
         g_sender = 3.0 * lat_edge + tx_ms[:, None]
         g_ms = jnp.where(valid, _edge_gather(g_sender, conns, rev), INF)
         g_ok = valid & _edge_gather(g_tgt & can_send[:, None], conns, rev)
+        g_off = _edge_gather(g_off_s, conns, rev)
     else:
         g_ms = jnp.full_like(a_ms, INF)
         g_ok = jnp.zeros_like(mesh_ok)
+        g_off = jnp.zeros_like(a_ms)
     phase = _edge_gather(
         jnp.broadcast_to(hb_phase[:, None], conns.shape), conns, rev)
+    u_ms = _edge_gather(
+        jnp.broadcast_to(uplink_free[:, None], conns.shape), conns, rev)
     return RecvConstants(
         src=jnp.where(valid, conns, -1),
         a_ms=a_ms,
         mesh_ok=mesh_ok,
         g_ms=g_ms,
         g_ok=g_ok,
+        g_off=g_off,
         phase=phase,
+        u_ms=u_ms,
         proc_ms=jnp.float32(proc_ms),
         hb_ms=jnp.float32(hb_ms),
     )
@@ -111,10 +126,13 @@ def _inc_from(t_all: jnp.ndarray, c: RecvConstants) -> jnp.ndarray:
     """Incoming offers of every receiver slot given the global t_rx."""
     t_src = t_all[jnp.clip(c.src, 0)]
     live = (c.src >= 0) & (t_src < INF)
-    inc = jnp.where(c.mesh_ok & live, t_src + c.a_ms, INF)
     base = t_src + c.proc_ms
+    # a sender's queue can't start before its uplink drains earlier traffic
+    start = jnp.maximum(base, c.u_ms)
+    inc = jnp.where(c.mesh_ok & live, start + c.a_ms, INF)
     hb = (jnp.floor((base - c.phase) / c.hb_ms) + 1.0) * c.hb_ms + c.phase
-    inc_g = jnp.where(c.g_ok & live, hb + c.g_ms, INF)
+    inc_g = jnp.where(
+        c.g_ok & live, jnp.maximum(hb + c.g_off, c.u_ms) + c.g_ms, INF)
     return jnp.minimum(inc, inc_g)
 
 
@@ -144,10 +162,11 @@ def converge_sharded(
     and psums one convergence bit. Identical results to converge_recv."""
     rows = P(PEER_AXIS)
 
-    def local_fix(t0_l, src, a_ms, mesh_ok, g_ms, g_ok, phase):
+    def local_fix(t0_l, src, a_ms, mesh_ok, g_ms, g_ok, g_off, phase, u_ms):
         c_l = RecvConstants(
             src=src, a_ms=a_ms, mesh_ok=mesh_ok, g_ms=g_ms, g_ok=g_ok,
-            phase=phase, proc_ms=c.proc_ms, hb_ms=c.hb_ms,
+            g_off=g_off, phase=phase, u_ms=u_ms,
+            proc_ms=c.proc_ms, hb_ms=c.hb_ms,
         )
 
         def cond(carry):
@@ -168,10 +187,11 @@ def converge_sharded(
     fn = jax.shard_map(
         local_fix,
         mesh=mesh,
-        in_specs=(rows, rows, rows, rows, rows, rows, rows),
+        in_specs=(rows,) * 9,
         out_specs=rows,
     )
-    return fn(t0, c.src, c.a_ms, c.mesh_ok, c.g_ms, c.g_ok, c.phase)
+    return fn(t0, c.src, c.a_ms, c.mesh_ok, c.g_ms, c.g_ok, c.g_off,
+              c.phase, c.u_ms)
 
 
 def place_sharded(mesh: Mesh, *arrays):

@@ -49,18 +49,19 @@ class PeerTraffic:
     ctrl_tx: np.ndarray         # (N,) control packets sent
 
     @classmethod
-    def from_state(cls, state, ihave_total: int = 0, iwant_total: int = 0):
-        """Build from a SimState; scalar gossip counters are spread evenly
-        (the awk output only consumes network-wide control sums)."""
+    def from_state(cls, state):
+        """Build from a SimState. Control packets are real per-peer counters:
+        a peer's ctrl_tx is the IHAVEs + IWANTs it sent, ctrl_rx the ones
+        addressed to it (SimState.ihave_tx/iwant_tx/ihave_rx/iwant_rx) — the
+        shadowlog's per-node ctrl fields are per-node in the reference too
+        (summary_shadowlog.awk:3-8)."""
         rx = np.asarray(state.bytes_rx, dtype=np.float64)
         tx = np.asarray(state.bytes_tx, dtype=np.float64)
-        n = rx.shape[0]
-        ctrl = np.zeros(n)
-        total_ctrl = int(ihave_total) + int(iwant_total)
-        if total_ctrl > 0:
-            ctrl += total_ctrl // n
-            ctrl[: total_ctrl % n] += 1
-        return cls(rx_bytes=rx, tx_bytes=tx, ctrl_rx=ctrl.copy(), ctrl_tx=ctrl)
+        ctrl_tx = (np.asarray(state.ihave_tx, dtype=np.float64)
+                   + np.asarray(state.iwant_tx, dtype=np.float64))
+        ctrl_rx = (np.asarray(state.ihave_rx, dtype=np.float64)
+                   + np.asarray(state.iwant_rx, dtype=np.float64))
+        return cls(rx_bytes=rx, tx_bytes=tx, ctrl_rx=ctrl_rx, ctrl_tx=ctrl_tx)
 
 
 def _data_pkts(data_bytes: np.ndarray) -> np.ndarray:

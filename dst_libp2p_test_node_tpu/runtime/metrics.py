@@ -348,8 +348,16 @@ class NodeMetrics:
             float(sum(bytes_rx[r] for r in rows)), labels={"direction": "in"})
         self.broadcast_graft.set(float(np.asarray(st.grafts)))
         self.received_prune.set(float(np.asarray(st.prunes)))
-        self.broadcast_ihave.set(float(np.asarray(st.ihave_tx)))
-        self.broadcast_iwant.set(float(np.asarray(st.iwant_tx)))
+        # per-peer counters restricted to THIS node's rows, like every other
+        # per-peer series above (the exporter is one simulated node's view)
+        ihave_tx = np.asarray(st.ihave_tx)
+        iwant_tx = np.asarray(st.iwant_tx)
+        ihave_rx = np.asarray(st.ihave_rx)
+        iwant_rx = np.asarray(st.iwant_rx)
+        self.broadcast_ihave.set(float(sum(ihave_tx[r] for r in rows)))
+        self.broadcast_iwant.set(float(sum(iwant_tx[r] for r in rows)))
+        self.received_ihave.set(float(sum(ihave_rx[r] for r in rows)))
+        self.received_iwant.set(float(sum(iwant_rx[r] for r in rows)))
         self.duplicates.set(float(sum(dup[r] for r in rows)))
 
     def render(self) -> str:

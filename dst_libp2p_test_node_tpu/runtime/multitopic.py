@@ -141,8 +141,14 @@ class MultiTopicSimulator:
             # classifies as "no peers" in the health metric
             self.subscribed_np = rng.random((tcount, n)) < cfg.subscribe_fraction
         self.state = init_state(self.params, seed=cfg.seed)
+        # a physical node's heartbeat timer is shared by all its topics: tile
+        # one per-NODE phase draw across the topic blocks (same for the
+        # uplink below — the T*N rows are one host's T protocol views, not
+        # T*N hosts)
+        phase_node = np.asarray(self.state.hb_phase)[:n]
         self.state = self.state.replace(
-            subscribed=jnp.asarray(self.subscribed_np.reshape(-1)))
+            subscribed=jnp.asarray(self.subscribed_np.reshape(-1)),
+            hb_phase=jnp.asarray(np.tile(phase_node, tcount)))
         self._hb_carry_ms = 0.0
         self.records: list[tuple[str, MessageRecord]] = []
         self._msg_rng = np.random.default_rng(cfg.seed ^ 0x6D736749)
@@ -199,6 +205,16 @@ class MultiTopicSimulator:
             loss_stage=self._loss,
             with_fanout=not bool(self.subscribed_np[ti][publisher]),
         )
+        # one uplink per physical NODE: fold the per-row occupancy across
+        # topic blocks so a publish on topic B queues behind topic A's
+        # in-flight traffic (the reference's per-connection queues carry all
+        # topics of a host; cross-topic coupling happens at publish
+        # granularity, which is exact for this host-sequential publish loop)
+        t_ct = len(self.cfg.topics)
+        if t_ct > 1:
+            u_node = self.state.uplink_free_ms.reshape(t_ct, n).max(axis=0)
+            self.state = self.state.replace(
+                uplink_free_ms=jnp.tile(u_node, t_ct))
         blk = slice(ti * n, (ti + 1) * n)
 
         class _Blk:  # the topic's N-row window of the stacked result
@@ -206,8 +222,8 @@ class MultiTopicSimulator:
             received = res.received[blk]
             sends = res.sends[blk]
             copies_rx = res.copies_rx[blk]
-            ihave_sent = res.ihave_sent
-            iwant_sent = res.iwant_sent
+            ihave_sent = res.ihave_sent[blk]
+            iwant_sent = res.iwant_sent[blk]
 
         rec = record_from_result(
             _Blk,

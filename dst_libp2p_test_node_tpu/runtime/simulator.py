@@ -105,8 +105,8 @@ def record_from_result(
         received=received,
         sends=np.asarray(res.sends),
         copies_rx=np.asarray(res.copies_rx),
-        ihave=int(res.ihave_sent),
-        iwant=int(res.iwant_sent),
+        ihave=int(np.asarray(res.ihave_sent).sum()),
+        iwant=int(np.asarray(res.iwant_sent).sum()),
     )
 
 
@@ -413,11 +413,7 @@ class Simulator:
         """Cumulative per-peer traffic counters (runtime/bandwidth.py)."""
         from .bandwidth import PeerTraffic
 
-        return PeerTraffic.from_state(
-            self.state,
-            ihave_total=int(self.state.ihave_tx),
-            iwant_total=int(self.state.iwant_tx),
-        )
+        return PeerTraffic.from_state(self.state)
 
     def write_shadowlog(self, path: str) -> int:
         """Write Shadow-heartbeat-shaped '[node]' lines: the input of

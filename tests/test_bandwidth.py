@@ -114,14 +114,19 @@ def test_summary_math():
     assert "Total Bytes Received" in txt and "Details..." in txt
 
 
-def test_from_state_spreads_ctrl():
+def test_from_state_per_peer_ctrl():
     class FakeState:
         bytes_rx = np.array([10.0, 20.0, 30.0])
         bytes_tx = np.array([1.0, 2.0, 3.0])
+        ihave_tx = np.array([4, 0, 0])
+        iwant_tx = np.array([0, 3, 0])
+        ihave_rx = np.array([0, 2, 2])
+        iwant_rx = np.array([3, 0, 1])
 
-    t = PeerTraffic.from_state(FakeState, ihave_total=4, iwant_total=3)
-    assert t.ctrl_tx.sum() == 7
-    assert t.ctrl_tx.max() - t.ctrl_tx.min() <= 1
+    t = PeerTraffic.from_state(FakeState)
+    # ctrl counters are REAL per-peer values, not an even spread
+    assert (t.ctrl_tx == np.array([4.0, 3.0, 0.0])).all()
+    assert (t.ctrl_rx == np.array([3.0, 2.0, 3.0])).all()
     assert (t.rx_bytes == FakeState.bytes_rx).all()
 
 

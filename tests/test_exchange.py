@@ -42,15 +42,23 @@ def _scenario(seed=0, with_gossip=True):
                         & (rng.random((N, c)) < 0.3)) \
         if with_gossip else jnp.zeros((N, c), bool)
     hb_phase = jnp.asarray(rng.uniform(0, HB, size=N).astype(np.float32))
+    # per-edge gossip-round offsets (mcache window rounds 0..2)
+    g_off = jnp.asarray(
+        (rng.integers(0, 3, size=(N, c)) * HB).astype(np.float32))
+    # nonzero uplink occupancy on some peers (cross-message contention term)
+    uplink = jnp.asarray(
+        (rng.uniform(0, 400, size=N) * (rng.random(N) < 0.5))
+        .astype(np.float32))
     consts = build_recv_constants(
         conns, rev, lat_edge, tx_ms, rank, k_p, 0.0, send_mask, can_send,
-        g_tgt, hb_phase, PROC, HB, with_gossip,
+        g_tgt, g_off, hb_phase, uplink, PROC, HB, with_gossip,
     )
-    return graph, lat_edge, tx_ms, send_mask, rank, k_p, g_tgt, hb_phase, consts
+    return (graph, lat_edge, tx_ms, send_mask, rank, k_p, g_tgt, g_off,
+            hb_phase, uplink, consts)
 
 
 def _dense_reference(graph, lat_edge, tx_ms, send_mask, rank, k_p,
-                     g_tgt, hb_phase, t0, iters=64):
+                     g_tgt, g_off, hb_phase, uplink, t0, iters=64):
     """Host-side sender-perspective fixpoint (mirrors ops/disseminate's
     offers+pull semantics, written independently in numpy)."""
     conns = graph.conns
@@ -61,22 +69,27 @@ def _dense_reference(graph, lat_edge, tx_ms, send_mask, rank, k_p,
     rk = np.asarray(rank)
     kp = np.asarray(k_p)
     gt = np.asarray(g_tgt)
+    gf = np.asarray(g_off)
     ph = np.asarray(hb_phase)
+    up = np.asarray(uplink)
     for _ in range(iters):
         new = t.copy()
         for p in range(N):
             if t[p] >= 1e37:
                 continue
             base = t[p] + PROC
+            start = max(base, up[p])
             for i, q in enumerate(conns[p]):
                 if q < 0:
                     continue
                 if sm[p, i]:
-                    cand = base + (rk[p, i] + 1.0) * txm[p] + lat[p, i]
+                    cand = start + (rk[p, i] + 1.0) * txm[p] + lat[p, i]
                     new[q] = min(new[q], cand)
                 if gt[p, i]:
                     hb = (np.floor((base - ph[p]) / HB) + 1.0) * HB + ph[p]
-                    new[q] = min(new[q], hb + 3.0 * lat[p, i] + txm[p])
+                    new[q] = min(
+                        new[q],
+                        max(hb + gf[p, i], up[p]) + 3.0 * lat[p, i] + txm[p])
         if (new == t).all():
             break
         t = new
@@ -85,14 +98,14 @@ def _dense_reference(graph, lat_edge, tx_ms, send_mask, rank, k_p,
 
 @pytest.mark.parametrize("with_gossip", [False, True])
 def test_recv_fixpoint_matches_dense_reference(with_gossip):
-    (graph, lat_edge, tx_ms, send_mask, rank, k_p, g_tgt, hb_phase,
-     consts) = _scenario(seed=1, with_gossip=with_gossip)
+    (graph, lat_edge, tx_ms, send_mask, rank, k_p, g_tgt, g_off, hb_phase,
+     uplink, consts) = _scenario(seed=1, with_gossip=with_gossip)
     t0 = jnp.full((N,), INF).at[0].set(123.0)
     got = np.asarray(converge_recv(t0, consts, 64), dtype=np.float64)
     t0_np = np.full(N, np.float64(np.asarray(INF)))
     t0_np[0] = 123.0
     want = _dense_reference(graph, lat_edge, tx_ms, send_mask, rank, k_p,
-                            g_tgt, hb_phase, t0_np)
+                            g_tgt, g_off, hb_phase, uplink, t0_np)
     reached = want < 1e37
     assert reached.sum() > N // 2     # scenario actually disseminates
     np.testing.assert_allclose(got[reached], want[reached], rtol=1e-5)
@@ -100,7 +113,7 @@ def test_recv_fixpoint_matches_dense_reference(with_gossip):
 
 
 def test_sharded_matches_single_shard_exactly():
-    (_, _, _, _, _, _, _, _, consts) = _scenario(seed=2, with_gossip=True)
+    consts = _scenario(seed=2, with_gossip=True)[-1]
     t0 = jnp.full((N,), INF).at[3].set(0.0)
     single = np.asarray(converge_recv(t0, consts, 64))
 
@@ -111,7 +124,7 @@ def test_sharded_matches_single_shard_exactly():
 
 
 def test_sharded_under_jit_compiles_collectives():
-    (_, _, _, _, _, _, _, _, consts) = _scenario(seed=3, with_gossip=False)
+    consts = _scenario(seed=3, with_gossip=False)[-1]
     mesh = make_peer_mesh(8)
 
     @jax.jit

@@ -104,3 +104,34 @@ def test_unsubscribed_publisher_uses_fanout():
     rec = s.publish("a", publisher=unsub)
     assert rec.received[s.subscribed_np[0]].mean() > 0.5
     assert not rec.received[unsub]
+
+
+def test_cross_topic_uplink_coupling():
+    # a physical node's uplink is shared by its topics: a publish on topic B
+    # right after one on topic A queues behind A's in-flight traffic, while
+    # at 4 s spacing the uplinks have drained (same RNG state both ways)
+    cfg = _cfg(topo=TopoParams(
+        network_size=48, anchor_stages=2, min_bandwidth=50, max_bandwidth=100,
+        min_latency=30, max_latency=60, msg_size_bytes=15000),
+        with_gossip=False)
+    s1 = MultiTopicSimulator(cfg)
+    s1.warmup()
+    s1.publish("blocks", 7)
+    rec_close = s1.publish("attestations", 7)
+
+    s2 = MultiTopicSimulator(cfg)
+    s2.warmup()
+    s2.publish("blocks", 7)
+    s2.advance(4000.0)
+    rec_far = s2.publish("attestations", 7)
+
+    d_close = rec_close.delays_ms[rec_close.received]
+    d_far = rec_far.delays_ms[rec_far.received]
+    assert np.percentile(d_close, 50) > np.percentile(d_far, 50)
+
+
+def test_phase_shared_across_topics():
+    # one heartbeat timer per physical node, not one per (topic, node)
+    s = MultiTopicSimulator(_cfg())
+    ph = np.asarray(s.state.hb_phase).reshape(len(s.cfg.topics), s.n_peers)
+    assert (ph == ph[0]).all()
