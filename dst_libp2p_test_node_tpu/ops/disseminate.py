@@ -81,7 +81,7 @@ def _next_heartbeat(t, phase, hb_ms):
 @partial(
     jax.jit,
     static_argnames=("params", "payload_bytes", "fragments", "with_gossip",
-                     "mesh", "with_fanout"),
+                     "mesh", "with_fanout", "return_plan"),
 )
 def disseminate(
     state: SimState,
@@ -99,6 +99,7 @@ def disseminate(
     mesh=None,
     loss_stage=None,
     with_fanout: bool = False,
+    return_plan: bool = False,
 ):
     """Propagate one application message (all fragments) through the mesh.
 
@@ -120,6 +121,13 @@ def disseminate(
     turns loss into latency); mesh redundancy then degrades coverage
     gracefully, which is the effect the knob exists to study. Pass None
     (not an all-zero matrix) for the lossless fast path.
+
+    `return_plan`: additionally return the message's sampled "plan" — the
+    send sets, rank priorities, per-round gossip targets, loss survivals,
+    phases and uplink occupancy this call drew — as a third output. This is
+    the seam for the independent discrete-event cross-check
+    (tests/test_des_crosscheck.py): the DES replays the exact same model
+    inputs through an event queue written independently of the fixpoint.
 
     `with_fanout`: the publisher is NOT subscribed to the topic (gossipsub
     v1.1 fanout publish). It sends to its persistent fanout set — up to D
@@ -360,7 +368,16 @@ def disseminate(
         # first sender is whoever DELIVERED (lost copies can't be it)
         inc1 = pull(offers(t1, rank1, k1, frag_idx, tgt_f, deliver_only=True))
         first_slot = jnp.argmin(inc1, axis=-1)
-        got_remote = (inc1.min(axis=-1) <= t1) & (jnp.arange(n) != publisher)
+        # the min offer equals t1 BY CONSTRUCTION at the fixpoint (every
+        # reached non-publisher peer's time IS some offer), but offers() and
+        # the converge body associate the same sum differently in f32, so the
+        # equality needs a tolerance or a 1-ulp wobble leaves a receiver's
+        # back-edge in place (caught by the DES cross-check). The relative
+        # term keeps the tolerance above the f32 ulp at large sim times; a
+        # generous value is safe — the only peers whose min offer truly
+        # exceeds t1 are unreached ones (INF on both sides)
+        got_remote = (inc1.min(axis=-1) <= t1 + 0.01 + 1e-5 * t1) \
+            & (jnp.arange(n) != publisher)
         # row-wise one-hot via fused iota compare (scatters serialize on TPU)
         back = (jnp.arange(c) == first_slot[:, None]) & got_remote[:, None]
         send_mask = tgt_f & ~back
@@ -538,6 +555,20 @@ def disseminate(
                 state.fanout_expire,
             ),
         )
+    if return_plan:
+        plan = {
+            "tgt": tgt,                 # (N, C) data send set (pre queue-drop)
+            "rprio": rprio,             # (N, C) send-order priorities
+            "g_tgt_w": g_tgt_w,         # (W, N, C) per-round gossip targets
+            "survive": survive,         # (N, C) bool or None (loss)
+            "hb_phase": hb_phase,       # (N,)
+            "uplink": uplink,           # (N,) pre-message occupancy
+            "can_send": can_send,       # (N,)
+            "tx_ms": tx_ms,             # (N,) per-fragment uplink ms
+            "lat_edge": lat_edge,       # (N, C) per-slot latency
+            "t_pubs": t_pubs,           # (F,) per-fragment publish times
+        }
+        return result, new_state, plan
     return result, new_state
 
 
