@@ -90,6 +90,20 @@ def main() -> None:
             res, state = publish(state, 4 + i)
         jax.block_until_ready(state.mesh_mask)
     wall = time.time() - t0
+    # per-phase split from a SEPARATE instrumented pass: the inner syncs it
+    # needs would change dispatch overlap inside the metric-of-record loop,
+    # so they must not ride there
+    hb_s = 0.0
+    dis_s = 0.0
+    for i in range(MESSAGES):
+        t1 = time.time()
+        state = hb(state, per_burst)
+        jax.block_until_ready(state.t_ms)
+        hb_s += time.time() - t1
+        t1 = time.time()
+        _, state = publish(state, 7 + i)
+        jax.block_until_ready(state.bytes_tx)
+        dis_s += time.time() - t1
 
     rounds = MESSAGES * per_burst
     value = N_PEERS * rounds / wall
@@ -104,6 +118,10 @@ def main() -> None:
             "n_peers": N_PEERS,
             "rounds": rounds,
             "wall_s": round(wall, 3),
+            # per-phase split so heartbeat vs dissemination regressions are
+            # attributable across rounds
+            "hb_s": round(hb_s, 3),
+            "disseminate_s": round(dis_s, 3),
             "backend": jax.default_backend(),
             "coverage": coverage,
             "p50_ms": float(np.percentile(delays[ok], 50)),

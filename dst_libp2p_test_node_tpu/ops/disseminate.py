@@ -45,8 +45,13 @@ import jax
 import jax.numpy as jnp
 from flax import struct
 
-from ..parallel.exchange import build_recv_constants, converge_sharded
+from ..parallel.exchange import (
+    build_recv_constants,
+    converge_recv,
+    converge_sharded,
+)
 from .pull import (
+    exceeds_budget,
     neighbor_pull_bool,
     neighbor_pull_min,
     reciprocal_pull_bool,
@@ -306,9 +311,23 @@ def disseminate(
                 params.proc_delay_ms, params.heartbeat_ms, with_gossip,
             )
             return converge_sharded(t0, c, params.max_relax_iters, mesh)
-        # single device: sender-major offers (loop-invariant parts hoisted
-        # here), row-gather pull per iteration — ~2.5x the per-iteration
-        # speed of a receiver-side index gather (ops/pull.py)
+        if exceeds_budget(jnp.float32, conns.shape, fragments):
+            # large N (1M-peer class): the row-gather pull would blow the
+            # memory budget and its 2-index fallback costs ~0.7 s/iteration —
+            # switch to the receiver-side constant formulation: per-edge
+            # constants gathered ONCE, then each iteration is (N, C)
+            # elementwise plus one gather of the (N,) time vector (a 4 MB
+            # table at 1M peers vs a 160 MB one), the same expression the
+            # sharded path runs.
+            c = build_recv_constants(
+                conns, rev, lat_edge, tx_ms, rank, k_p, frag_idx, deliver,
+                can_send, g_deliver, g_off, hb_phase, uplink,
+                params.proc_delay_ms, params.heartbeat_ms, with_gossip,
+            )
+            return converge_recv(t0, c, params.max_relax_iters)
+        # single device below the budget: sender-major offers (loop-invariant
+        # parts hoisted here), row-gather pull per iteration — ~2.5x the
+        # per-iteration speed of a receiver-side index gather (ops/pull.py)
         queue = (rank + 1.0 + frag_idx * k_p[:, None]) * tx_ms[:, None]
         a_base = jnp.where(
             deliver & can_send[:, None], queue + lat_edge, INF)

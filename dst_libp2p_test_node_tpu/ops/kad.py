@@ -191,6 +191,24 @@ def _closest_from_table(table: jnp.ndarray, keys: jnp.ndarray,
     return best
 
 
+def _teach_learners(state: KadState, flat_peers: jnp.ndarray,
+                    flat_origin: jnp.ndarray, extra_ok=None,
+                    e_cap: int = 8) -> KadState:
+    """Group flat (learner <- candidate) events by learner with
+    capacity-bounded segment ranks and batch-insert into every learner's
+    table — the shared scatter behind find_node's query-learning pass and
+    connect_found's dial-backs."""
+    n = state.rtable.shape[0]
+    rank, _ = _segment_rank(jnp.where(flat_peers >= 0, flat_peers, n))
+    ok = (flat_peers >= 0) & (rank < e_cap)
+    if extra_ok is not None:
+        ok = ok & extra_ok
+    learn = jnp.full((n, e_cap), -1, jnp.int32).at[
+        jnp.where(ok, flat_peers, n), jnp.where(ok, rank, 0)
+    ].set(jnp.where(ok, flat_origin, -1), mode="drop")
+    return rtable_insert(state, jnp.arange(n, dtype=jnp.int32), learn)
+
+
 @struct.dataclass
 class LookupResult:
     closest: jnp.ndarray     # (Q, K_RESP) int32 final shortlist heads
@@ -320,13 +338,7 @@ def find_node(
     # so parallel lookups hitting the same responder all land
     flat_peers = picked_seq.reshape(-1)
     flat_origin = jnp.broadcast_to(origins[:, None], picked_seq.shape).reshape(-1)
-    e_cap = 8
-    rank, _ = _segment_rank(jnp.where(flat_peers >= 0, flat_peers, n))
-    ok = (flat_peers >= 0) & (rank < e_cap)
-    learn_cands = jnp.full((n, e_cap), -1, jnp.int32).at[
-        jnp.where(ok, flat_peers, n), jnp.where(ok, rank, 0)
-    ].set(jnp.where(ok, flat_origin, -1), mode="drop")
-    state = rtable_insert(state, jnp.arange(n, dtype=jnp.int32), learn_cands)
+    state = _teach_learners(state, flat_peers, flat_origin)
 
     served = jnp.zeros((n,), jnp.int32).at[
         jnp.where(flat_peers >= 0, flat_peers, n)
@@ -341,6 +353,55 @@ def find_node(
         queried=picked_seq, n_queries=nq,
     )
     return result, state
+
+
+@jax.jit
+def evict_failed(state: KadState, origins: jnp.ndarray,
+                 found: jnp.ndarray) -> KadState:
+    """DISCOVERY=extended (KademliaDiscovery) eviction: the discovery layer
+    exists to hand the application CONNECTABLE peers, so after the
+    end-of-lookup dial-out to the FOUND peers, every dial that fails (a
+    dead shortlist entry — queried peers are alive by construction, the
+    lookup's candidate filter sees to that) drops the entry from the
+    dialer's routing table. Plain KadDHT mode keeps the stale entry (the
+    LRU-keep-without-ping-eviction policy of rtable_insert). Buckets are
+    re-packed left so the append-position arithmetic of _insert_one stays
+    valid.
+
+    `found`: (Q, K) shortlist heads each origin dials
+    (LookupResult.closest)."""
+    dead = ~state.alive
+
+    def evict_one(table, f_ids):
+        bad_ids = jnp.where((f_ids >= 0) & dead[jnp.clip(f_ids, 0)],
+                            f_ids, -2)
+        is_bad = (table[..., None] == bad_ids).any(axis=-1)
+        marked = jnp.where(is_bad, -1, table)
+        # compact each bucket: keep entries left-packed, holes to the right
+        order = jnp.argsort(marked < 0, axis=-1, stable=True)
+        return jnp.take_along_axis(marked, order, axis=-1)
+
+    new_rows = jax.vmap(evict_one)(state.rtable[origins], found)
+    return state.replace(rtable=state.rtable.at[origins].set(new_rows))
+
+
+@jax.jit
+def connect_found(state: KadState, origins: jnp.ndarray,
+                  found: jnp.ndarray) -> KadState:
+    """DISCOVERY=extended (KademliaDiscovery, kad-dht/helpers.nim:48-57)
+    dial-backs: after a lookup the origin connects to the peers it found,
+    so every live entry of the final shortlist learns the origin. Plain
+    KadDHT mode only teaches the origin to the peers it QUERIED
+    (find_node's learning pass).
+
+    `found`: (Q, K) shortlist heads per origin (LookupResult.closest)."""
+    flat_peers = found.reshape(-1)
+    flat_origin = jnp.broadcast_to(
+        origins[:, None], found.shape).reshape(-1)
+    # dead peers answer no dial; self-dials don't happen
+    extra_ok = ((flat_peers != flat_origin)
+                & state.alive[jnp.clip(flat_peers, 0)])
+    return _teach_learners(state, flat_peers, flat_origin, extra_ok)
 
 
 @jax.jit

@@ -121,6 +121,10 @@ class KadSimulator:
             cfg.n_bootstrap, n - cfg.n_probe, dtype=jnp.int32
         )
         self.probes = jnp.arange(n - cfg.n_probe, n, dtype=jnp.int32)
+        # DISCOVERY=extended mounts KademliaDiscovery instead of KadDHT
+        # (kad-dht/helpers.nim:36-59): discovery connects to what it finds,
+        # so each lookup wave ends with dial-backs from the found peers
+        self.extended = cfg.discovery == "extended"
         self.t_ms = 0.0
         self.lines: list[str] = []
         self.lookups: list[LookupRecord] = []
@@ -132,6 +136,23 @@ class KadSimulator:
 
     def _key_hex(self, key_row: np.ndarray) -> str:
         return "".join(f"{int(w):08x}" for w in key_row)
+
+    def _wave(self, origins, targets):
+        """One batched FIND_NODE wave; in extended (KademliaDiscovery) mode
+        the origins then connect to the peers they found (kad.connect_found
+        dial-backs) and evict entries whose dial failed (kad.evict_failed) —
+        the mode's observable differences: symmetric knowledge and tables
+        that self-clean under churn."""
+        res, self.state = kad.find_node(
+            self.state, origins, targets, self._stage, self._lat
+        )
+        if self.extended:
+            # dial-out to the found peers: failed dials (dead entries) are
+            # evicted from the dialer's table, successful ones teach the
+            # found peer the origin
+            self.state = kad.evict_failed(self.state, origins, res.closest)
+            self.state = kad.connect_found(self.state, origins, res.closest)
+        return res
 
     def _record_wave(self, origins, targets, res, self_lookup: bool) -> None:
         o = np.asarray(origins)
@@ -178,10 +199,7 @@ class KadSimulator:
             return
         self._log("Starting warmup phase")
         for i in range(1, 6):
-            res, self.state = kad.find_node(
-                self.state, origins, self.state.keys[origins],
-                self._stage, self._lat,
-            )
+            res = self._wave(origins, self.state.keys[origins])
             self._record_wave(origins, self.state.keys[origins], res, True)
             census = np.asarray(kad.rtable_census(self.state))
             self._log(f"Warmup: Finding self iteration={i}")
@@ -193,9 +211,7 @@ class KadSimulator:
         for i in range(1, 16):
             self._probe_key, k = jax.random.split(self._probe_key)
             targets = kad.random_targets(k, origins.shape[0])
-            res, self.state = kad.find_node(
-                self.state, origins, targets, self._stage, self._lat
-            )
+            res = self._wave(origins, targets)
             self._record_wave(origins, targets, res, False)
             self._log(f"Warmup: Finding random node iteration={i}")
             self.t_ms += 2000.0
@@ -217,9 +233,7 @@ class KadSimulator:
         for _ in range(ticks):
             self._probe_key, k = jax.random.split(self._probe_key)
             targets = kad.random_targets(k, origins.shape[0])
-            res, self.state = kad.find_node(
-                self.state, origins, targets, self._stage, self._lat
-            )
+            res = self._wave(origins, targets)
             self._record_wave(origins, targets, res, False)
             lat = np.asarray(res.latency_ms)
             tg = np.asarray(targets)

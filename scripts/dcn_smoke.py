@@ -1,0 +1,125 @@
+"""Two-process DCN smoke for parallel/sharding.initialize_multihost.
+
+The reference scales across hosts by pointing more Shadow workers / K8s nodes
+at the same experiment; the TPU framework's equivalent is a jax.distributed
+process group whose global device mesh spans hosts, with the same engine code
+running unchanged (SURVEY.md §2 "multi-pod via DCN"). Real multi-host TPU
+hardware is not available in this environment, so this smoke proves the
+multi-host path end-to-end on the only fabric that exists here: two local
+processes, CPU devices, gloo collectives over localhost — the same
+jax.distributed machinery a v5e pod slice uses, minus the ICI.
+
+Each process:
+  1. joins the group via initialize_multihost (the wrapper under test),
+  2. checks the GLOBAL device view spans both processes,
+  3. builds the 1-D peer mesh over all global devices (make_peer_mesh),
+  4. runs a shard_map psum over the mesh and checks the result — a real
+     cross-process collective, the primitive every fixpoint iteration of
+     the sharded engine rides on.
+
+Run:  python scripts/dcn_smoke.py            (spawns both workers, checks both)
+      python scripts/dcn_smoke.py --worker I (internal: one group member)
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+PORT = int(os.environ.get("DCN_SMOKE_PORT", "51217"))
+DEVS_PER_PROC = 4
+NUM_PROCS = 2
+
+
+def worker(process_id: int) -> None:
+    # env must be set before jax import: per-process virtual CPU devices +
+    # gloo cross-process collectives
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    # replace (not prepend) any inherited device-count flag — XLA honors the
+    # last occurrence, and test environments commonly pin their own count
+    kept = [f for f in os.environ.get("XLA_FLAGS", "").split()
+            if not f.startswith("--xla_force_host_platform_device_count")]
+    os.environ["XLA_FLAGS"] = " ".join(
+        kept + [f"--xla_force_host_platform_device_count={DEVS_PER_PROC}"])
+    os.environ["JAX_CPU_COLLECTIVES_IMPLEMENTATION"] = "gloo"
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from dst_libp2p_test_node_tpu.parallel.sharding import (
+        initialize_multihost, make_peer_mesh, peer_sharding,
+    )
+
+    pid = initialize_multihost(
+        coordinator_address=f"localhost:{PORT}",
+        num_processes=NUM_PROCS,
+        process_id=process_id,
+    )
+    assert pid == process_id, (pid, process_id)
+    n_global = len(jax.devices())
+    assert n_global == NUM_PROCS * DEVS_PER_PROC, n_global
+    assert len(jax.local_devices()) == DEVS_PER_PROC
+
+    mesh = make_peer_mesh()
+    n = 64
+    sh = peer_sharding(mesh)
+    # build the globally-sharded array from per-process local shards
+    local_rows = n // NUM_PROCS
+    local = np.arange(n, dtype=np.float32)[
+        process_id * local_rows:(process_id + 1) * local_rows]
+    arr = jax.make_array_from_process_local_data(sh, local, (n,))
+
+    def body(x):
+        return jax.lax.psum(x.sum(), "peers") * jnp.ones_like(x)
+
+    out = jax.jit(jax.shard_map(
+        body, mesh=mesh, in_specs=P("peers"), out_specs=P("peers")))(arr)
+    # every element is the GLOBAL sum — proof the collective crossed the
+    # process boundary (reading this process's local shard suffices)
+    expect = float(np.arange(n).sum())
+    got = float(np.asarray(out.addressable_shards[0].data)[0])
+    assert got == expect, (got, expect)
+    print(f"worker {process_id}: global_devices={n_global} psum={got} OK",
+          flush=True)
+
+
+def main() -> int:
+    here = os.path.dirname(os.path.abspath(__file__))
+    repo = os.path.dirname(here)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--worker", str(i)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for i in range(NUM_PROCS)
+    ]
+    ok = True
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=300)
+            sys.stdout.write(out)
+            if p.returncode != 0 or "OK" not in out:
+                ok = False
+    except subprocess.TimeoutExpired:
+        # a hung worker must not orphan its sibling (the coordinator port
+        # stays bound otherwise and the next run cannot bind it)
+        ok = False
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    print("dcn_smoke:", "PASS" if ok else "FAIL")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    if "--worker" in sys.argv:
+        worker(int(sys.argv[sys.argv.index("--worker") + 1]))
+    else:
+        sys.exit(main())

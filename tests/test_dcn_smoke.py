@@ -1,0 +1,26 @@
+"""CI-runnable wrapper for the two-process DCN smoke (scripts/dcn_smoke.py):
+initialize_multihost joins two local processes into one jax.distributed
+group, the global mesh spans both, and a shard_map psum crosses the process
+boundary over gloo — the multi-host story of parallel/sharding.py proven on
+the only fabric this environment has."""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_two_process_dcn_smoke():
+    env = dict(os.environ)
+    # CPU-only child processes: skip the accelerator plugin entirely and use
+    # a test-specific port so parallel runs don't collide
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["DCN_SMOKE_PORT"] = "51913"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "dcn_smoke.py")],
+        capture_output=True, text=True, timeout=300, env=env,
+    )
+    assert r.returncode == 0, r.stdout + "\n" + r.stderr
+    assert "dcn_smoke: PASS" in r.stdout

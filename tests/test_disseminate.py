@@ -72,7 +72,7 @@ def test_star_uplink_serialization():
     np.testing.assert_allclose(delays, expect, rtol=1e-5)
 
 
-def mesh_setup(n=100, connect_to=10, seed=0, hb=10, **over):
+def mesh_setup(*, n=100, connect_to=10, seed=0, hb=10, **over):
     g = build_connection_graph(n, connect_to, seed=seed)
     params = SimParams(n=n, capacity=g.capacity, **over)
     state = init_state(params, seed=seed)
@@ -265,6 +265,34 @@ def test_uplink_occupancy_couples_concurrent_messages():
     # different t0 magnitudes; spacing-invariance is exact modulo that
     np.testing.assert_allclose(
         np.asarray(r_far.delay_ms), np.asarray(r_far2.delay_ms),
+        rtol=1e-4, atol=0.05)
+
+
+def test_receiver_side_large_n_path_matches(monkeypatch):
+    # above the row-gather memory budget the single-device fixpoint switches
+    # to the receiver-side constant formulation (the 1M-peer path); it must
+    # produce the same arrival times as the sender-major path. Use a fresh
+    # N so no cached trace of the other branch is reused, and shrink the
+    # budget so the same shapes compile through the large-N branch.
+    import dst_libp2p_test_node_tpu.ops.pull as pull_mod
+
+    n = 101
+    g, params, state, a, (stage, lat, bw) = mesh_setup(n=n)
+    kw = dict(publisher=7, t0_ms=float(state.t_ms), params=params,
+              payload_bytes=15000, with_gossip=True)
+    res_ref, _ = disseminate(state, a["conns"], a["rev"], stage, lat, bw, **kw)
+    monkeypatch.setattr(pull_mod, "_MAX_INTERMEDIATE_BYTES", 1)
+    disseminate.clear_cache()
+    try:
+        res_big, _ = disseminate(
+            state, a["conns"], a["rev"], stage, lat, bw, **kw)
+    finally:
+        monkeypatch.undo()
+        disseminate.clear_cache()
+    np.testing.assert_array_equal(
+        np.asarray(res_ref.received), np.asarray(res_big.received))
+    np.testing.assert_allclose(
+        np.asarray(res_ref.delay_ms), np.asarray(res_big.delay_ms),
         rtol=1e-4, atol=0.05)
 
 

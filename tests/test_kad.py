@@ -190,3 +190,66 @@ def test_config_from_env_roundtrip(monkeypatch):
         bad.validate()
     with pytest.raises(ValueError):
         KadConfig(n_probe=-5).validate()
+
+
+def test_extended_discovery_self_cleans_under_churn():
+    # DISCOVERY=extended mounts KademliaDiscovery (kad-dht/helpers.nim:48-57):
+    # discovery hands the application CONNECTABLE peers, so a failed dial
+    # evicts the stale entry — under churn its routing tables shed dead
+    # peers, while plain KadDHT keeps them (LRU-keep, no ping eviction).
+    import numpy as np
+    import jax.numpy as jnp
+
+    def dead_entries(sim, alive):
+        rt = np.asarray(sim.state.rtable)
+        dead = 0
+        for p in range(rt.shape[0]):
+            e = rt[p].reshape(-1)
+            e = e[e >= 0]
+            dead += int((~alive[e]).sum())
+        return dead
+
+    counts = {}
+    for disc in ("kad-dht", "extended"):
+        cfg = KadConfig(network_size=96, n_bootstrap=2, n_probe=20,
+                        probe_duration_s=30.0, seed=3, discovery=disc)
+        sim = KadSimulator(cfg)
+        sim.boot()
+        sim.warmup()
+        # 25% of the normal population dies before the probe phase
+        alive = np.ones(96, bool)
+        rng = np.random.default_rng(9)
+        dead_ids = rng.choice(np.arange(2, 76), size=18, replace=False)
+        alive[dead_ids] = False
+        sim.state = sim.state.replace(alive=jnp.asarray(alive))
+        sim.probe()
+        counts[disc] = dead_entries(sim, alive)
+    assert counts["extended"] < counts["kad-dht"], counts
+
+
+def test_evict_failed_removes_dead_found_entries():
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dst_libp2p_test_node_tpu.ops import kad
+
+    state = kad.init_kad_state(32, seed=0)
+    state = kad.rtable_insert(
+        state, jnp.asarray([1]), jnp.asarray([[2, 3, 4]]))
+    alive = np.ones(32, bool)
+    alive[3] = False
+    state = state.replace(alive=jnp.asarray(alive))
+    assert (np.asarray(state.rtable[1]) == 3).any()
+    # origin 1 dials its found set {3, 2}: the dial to dead 3 fails -> evict
+    s2 = kad.evict_failed(state, jnp.asarray([1]), jnp.asarray([[3, 2]]))
+    after = np.asarray(s2.rtable[1])
+    assert not (after == 3).any()
+    assert (after == 2).any() and (after == 4).any()
+    # buckets stay left-packed (the insert position arithmetic relies on it)
+    for row in after:
+        hole = False
+        for v in row:
+            if v < 0:
+                hole = True
+            else:
+                assert not hole, row
