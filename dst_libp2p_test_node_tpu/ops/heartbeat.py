@@ -113,16 +113,16 @@ def heartbeat_step(
         grafted = (_ranks(g_prio) < need[:, None]) & eligible
         # GRAFT control msg: counterpart adds us to its mesh (handleGraft
         # accepts unless backed off; overflow is corrected at its own next
-        # heartbeat)
-        mesh = mesh | grafted
-        mesh = (mesh | _reciprocal_view(grafted, conns, rev, batch_factor)
-                ) & valid
-        return mesh, grafted
+        # heartbeat). The reciprocal view IS the receive side — return it
+        # so both directions can be counted per peer.
+        graft_rx = _reciprocal_view(grafted, conns, rev, batch_factor)
+        mesh = (mesh | grafted | graft_rx) & valid
+        return mesh, grafted, graft_rx
 
-    mesh, grafted = jax.lax.cond(
+    mesh, grafted, graft_rx = jax.lax.cond(
         (need > 0).any(),
         do_graft,
-        lambda m: (m, jnp.zeros_like(m)),
+        lambda m: (m, jnp.zeros_like(m), jnp.zeros_like(m)),
         mesh,
     )
 
@@ -155,12 +155,13 @@ def heartbeat_step(
         backoff = jnp.where(
             pruned | pruned_by_peer,
             t + params.prune_backoff_ms, state.backoff_until)
-        return mesh & ~pruned_by_peer, backoff, pruned
+        return mesh & ~pruned_by_peer, backoff, pruned, pruned_by_peer
 
-    mesh, backoff, pruned = jax.lax.cond(
+    mesh, backoff, pruned, prune_rx = jax.lax.cond(
         over.any(),
         do_prune,
-        lambda m: (m, state.backoff_until, jnp.zeros_like(m)),
+        lambda m: (m, state.backoff_until, jnp.zeros_like(m),
+                   jnp.zeros_like(m)),
         mesh,
     )
 
@@ -169,6 +170,7 @@ def heartbeat_step(
     # median (escape hatch from a low-quality mesh). Static-gated: at the
     # disabled default (-10000) the sort never enters the compiled step.
     og = jnp.zeros_like(mesh)
+    og_rx = jnp.zeros_like(mesh)
     if params.opportunistic_graft_threshold > -9999.0:
         scores = get_scores()
         deg3 = mesh.sum(axis=-1)
@@ -183,11 +185,14 @@ def heartbeat_step(
         og = (_ranks(og_prio) < 2) & og_elig
         # same steady-state economics as graft/prune: the reciprocal pull
         # only runs when something actually grafted
-        mesh = jax.lax.cond(
+        def do_og(m):
+            rx = _reciprocal_view(og, conns, rev, batch_factor)
+            return (m | og | rx) & valid, rx
+
+        mesh, og_rx = jax.lax.cond(
             og.any(),
-            lambda m: (m | og | _reciprocal_view(og, conns, rev, batch_factor))
-            & valid,
-            lambda m: m,
+            do_og,
+            lambda m: (m, jnp.zeros_like(m)),
             mesh,
         )
 
@@ -227,9 +232,12 @@ def heartbeat_step(
         alive=alive,
         t_ms=t + params.heartbeat_ms,
         key=key,
-        grafts=state.grafts + grafted.sum(dtype=jnp.int32)
-        + og.sum(dtype=jnp.int32),
-        prunes=state.prunes + pruned.sum(dtype=jnp.int32),
+        grafts=state.grafts + grafted.sum(axis=-1, dtype=jnp.int32)
+        + og.sum(axis=-1, dtype=jnp.int32),
+        grafts_rx=state.grafts_rx + graft_rx.sum(axis=-1, dtype=jnp.int32)
+        + og_rx.sum(axis=-1, dtype=jnp.int32),
+        prunes=state.prunes + pruned.sum(axis=-1, dtype=jnp.int32),
+        prunes_rx=state.prunes_rx + prune_rx.sum(axis=-1, dtype=jnp.int32),
     )
 
 

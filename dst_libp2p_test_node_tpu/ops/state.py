@@ -136,9 +136,13 @@ class SimState:
     #                             messages the way shared uplinks do.
     t_ms: jnp.ndarray           # () float32 — sim clock
     key: jnp.ndarray            # jax PRNG key
-    # cumulative observability counters (reference L5)
-    grafts: jnp.ndarray         # () int32
-    prunes: jnp.ndarray         # () int32
+    # cumulative observability counters (reference L5). GRAFT/PRUNE are
+    # control messages with a sender and a receiver; the Go tracer counts
+    # both directions per node (metrics.go:328-336), so all four are (N,)
+    grafts: jnp.ndarray         # (N,) int32 GRAFTs sent by each peer
+    grafts_rx: jnp.ndarray      # (N,) int32 GRAFTs received
+    prunes: jnp.ndarray         # (N,) int32 PRUNEs sent
+    prunes_rx: jnp.ndarray      # (N,) int32 PRUNEs received
     bytes_tx: jnp.ndarray       # (N,) float32
     bytes_rx: jnp.ndarray       # (N,) float32
     dup_rx: jnp.ndarray         # (N,) int32
@@ -179,8 +183,10 @@ def init_state(params: SimParams, seed: int = 0) -> SimState:
         uplink_free_ms=jnp.zeros((n,), dtype=jnp.float32),
         t_ms=jnp.asarray(0.0, dtype=jnp.float32),
         key=key,
-        grafts=jnp.asarray(0, dtype=jnp.int32),
-        prunes=jnp.asarray(0, dtype=jnp.int32),
+        grafts=jnp.zeros((n,), dtype=jnp.int32),
+        grafts_rx=jnp.zeros((n,), dtype=jnp.int32),
+        prunes=jnp.zeros((n,), dtype=jnp.int32),
+        prunes_rx=jnp.zeros((n,), dtype=jnp.int32),
         bytes_tx=jnp.zeros((n,), dtype=jnp.float32),
         bytes_rx=jnp.zeros((n,), dtype=jnp.float32),
         dup_rx=jnp.zeros((n,), dtype=jnp.int32),

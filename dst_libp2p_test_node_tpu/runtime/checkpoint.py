@@ -25,6 +25,7 @@ as the uninterrupted run (tests/test_checkpoint.py).
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 from dataclasses import asdict
@@ -35,7 +36,21 @@ from ..config.env import GossipSubParams
 from ..config.topology import Topology, TopoParams
 from .simulator import ExperimentConfig, MessageRecord, Simulator
 
-FORMAT_VERSION = 1
+FORMAT_VERSION = 2
+
+
+def _graph_hash(graph) -> str:
+    """Fingerprint of the connection graph the state arrays index into.
+    The graph is rebuilt from (n, connect_to, seed) on load, so resume is
+    bit-exact only while graph construction is code-identical — mesh_mask/
+    backoff/fmd columns refer to neighbor SLOTS, and a silently different
+    graph would remap every edge. The hash makes that failure loud."""
+    h = hashlib.sha256()
+    for arr in (graph.conns, graph.rev, graph.out_mask):
+        a = np.ascontiguousarray(np.asarray(arr))
+        h.update(str(a.shape).encode())
+        h.update(a.tobytes())
+    return h.hexdigest()
 
 _TOPO_KEYS = ("latency_ms", "bw_up_mbit", "packet_loss", "stage_of_peer")
 
@@ -82,6 +97,7 @@ def save_checkpoint(sim: Simulator, path: str) -> None:
 
     meta = {
         "version": FORMAT_VERSION,
+        "graph_sha256": _graph_hash(sim.graph),
         "cfg": asdict(sim.cfg),
         "hb_carry_ms": sim._hb_carry_ms,
         "msg_rng_state": sim._msg_rng.bit_generator.state,
@@ -126,6 +142,16 @@ def load_checkpoint(path: str, mesh=None) -> Simulator:
         topo_p, *(z[f"topo/{k}"] for k in _TOPO_KEYS)
     )
     sim = Simulator(cfg, topology=topology, mesh=mesh)
+    got = _graph_hash(sim.graph)
+    want = meta.get("graph_sha256", "")
+    if want and got != want:
+        raise ValueError(
+            "checkpoint graph mismatch: the rebuilt connection graph "
+            f"(sha256 {got[:12]}…) differs from the one the checkpoint was "
+            f"written against ({want[:12]}…). Graph-construction code "
+            "changed between save and load; the restored edge-slot state "
+            "would silently refer to different edges."
+        )
     state_dict = {
         k.split("/", 1)[1]: z[k] for k in z.files if k.startswith("state/")
     }

@@ -332,9 +332,21 @@ class NodeMetrics:
             for name, sz in sim.mesh_sizes().items():
                 self.mesh_per_topic.set(sz, labels={"topic": name})
                 self.gossipsub_per_topic.set(conns, labels={"topic": name})
-            # health judged from this node's WORST topic mesh
-            worst = min(int(mesh_np[r].sum()) for r in rows)
-            self.update_topic_health(worst, sim.params.d_low)
+            # health judged from this node's WORST JOINED topic mesh — the
+            # Go tracer classifies only topics the node subscribed to
+            # (metrics.go:348-380); unjoined topics always have degree 0
+            # and would otherwise pin every node at 'no mesh peers'. A node
+            # joined to NOTHING has no topics to classify: all three health
+            # gauges stay 0, it is not a 'no mesh peers' cohort member.
+            sub_rows = [r for t, r in enumerate(rows)
+                        if sim.subscribed_np[t][peer_id]]
+            if sub_rows:
+                worst = min(int(mesh_np[r].sum()) for r in sub_rows)
+                self.update_topic_health(worst, sim.params.d_low)
+            else:
+                self.no_peers_topics.set(0)
+                self.low_peers_topics.set(0)
+                self.healthy_peers_topics.set(0)
         else:
             self.mesh_per_topic.set(mesh_deg, labels={"topic": self.topic})
             self.gossipsub_per_topic.set(conns, labels={"topic": self.topic})
@@ -346,8 +358,14 @@ class NodeMetrics:
             float(sum(bytes_tx[r] for r in rows)), labels={"direction": "out"})
         self.network_bytes.set(
             float(sum(bytes_rx[r] for r in rows)), labels={"direction": "in"})
-        self.broadcast_graft.set(float(np.asarray(st.grafts)))
-        self.received_prune.set(float(np.asarray(st.prunes)))
+        grafts = np.asarray(st.grafts)
+        grafts_rx = np.asarray(st.grafts_rx)
+        prunes = np.asarray(st.prunes)
+        prunes_rx = np.asarray(st.prunes_rx)
+        self.broadcast_graft.set(float(sum(grafts[r] for r in rows)))
+        self.received_graft.set(float(sum(grafts_rx[r] for r in rows)))
+        self.broadcast_prune.set(float(sum(prunes[r] for r in rows)))
+        self.received_prune.set(float(sum(prunes_rx[r] for r in rows)))
         # per-peer counters restricted to THIS node's rows, like every other
         # per-peer series above (the exporter is one simulated node's view)
         ihave_tx = np.asarray(st.ihave_tx)

@@ -110,3 +110,40 @@ def test_run_resume_matches_uninterrupted(tmp_path):
     for ra, rb in zip(full.records, resumed.records):
         np.testing.assert_allclose(ra.delays_ms, rb.delays_ms)
         assert ra.msg_id == rb.msg_id
+
+
+def test_graph_mismatch_fails_loudly(tmp_path):
+    # ADVICE r1: the graph is rebuilt from code on load; if graph
+    # construction changed between save and load, the edge-slot state would
+    # silently remap — the stored fingerprint must catch it
+    import json
+
+    import numpy as np
+    import pytest
+
+    from dst_libp2p_test_node_tpu.config.topology import TopoParams
+    from dst_libp2p_test_node_tpu.runtime.checkpoint import (
+        load_checkpoint, save_checkpoint,
+    )
+    from dst_libp2p_test_node_tpu.runtime.simulator import (
+        ExperimentConfig, Simulator,
+    )
+
+    cfg = ExperimentConfig(
+        topo=TopoParams(network_size=16, msg_size_bytes=500, messages=1),
+        connect_to=4, warmup_s=2.0, seed=0,
+    )
+    sim = Simulator(cfg)
+    sim.warmup()
+    path = str(tmp_path / "ck.npz")
+    save_checkpoint(sim, path)
+    assert load_checkpoint(path) is not None  # clean round trip
+
+    # simulate changed graph-construction code: tamper the fingerprint
+    z = dict(np.load(path).items())
+    meta = json.loads(bytes(z["meta_json"]).decode())
+    meta["graph_sha256"] = "0" * 64
+    z["meta_json"] = np.frombuffer(json.dumps(meta).encode(), dtype=np.uint8)
+    np.savez_compressed(path, **z)
+    with pytest.raises(ValueError, match="graph mismatch"):
+        load_checkpoint(path)
