@@ -111,6 +111,12 @@ class GossipSubParams:
     flood_publish: bool = True
     opportunistic_graft_threshold: float = -10000.0
     gossip_factor: float = 0.25
+    # score thresholds: the reference parses these but comments the
+    # assignments out (main.nim:276-278,306-308), so nim-libp2p's defaults
+    # apply — these values. The env names match the commented-out surface.
+    gossip_threshold: float = -100.0
+    publish_threshold: float = -1000.0
+    graylist_threshold: float = -10000.0
     # mcache gossip window: IHAVE re-samples targets every heartbeat for this
     # many rounds after a message enters the cache (nim-libp2p
     # GossipSubHistoryGossip default; gossip every heartbeat over history,
@@ -146,6 +152,10 @@ class GossipSubParams:
         if self.history_gossip < 1:
             raise ValueError(
                 f"history_gossip must be >= 1, got {self.history_gossip}")
+        for name in ("gossip_threshold", "publish_threshold",
+                     "graylist_threshold"):
+            if getattr(self, name) > 0:
+                raise ValueError(f"{name} must be <= 0 (v1.1 spec)")
 
 
 def gossipsub_params_from_env() -> GossipSubParams:
@@ -171,6 +181,9 @@ def gossipsub_params_from_env() -> GossipSubParams:
         flood_publish=env_bool("GOSSIPSUB_FLOOD_PUBLISH", True),
         opportunistic_graft_threshold=env_float("GOSSIPSUB_OPPORTUNISTIC_GRAFT_THRESHOLD", -10000.0),
         gossip_factor=env_float("GOSSIPSUB_GOSSIP_FACTOR", 0.25),
+        gossip_threshold=env_float("GOSSIPSUB_GOSSIP_THRESHOLD", -100.0),
+        publish_threshold=env_float("GOSSIPSUB_PUBLISH_THRESHOLD", -1000.0),
+        graylist_threshold=env_float("GOSSIPSUB_GRAYLIST_THRESHOLD", -10000.0),
         history_gossip=env_int("GOSSIPSUB_HISTORY_GOSSIP", 3),
         idontwant_message_threshold=env_int("GOSSIPSUB_IDONTWANT_THRESHOLD", 1000),
     )

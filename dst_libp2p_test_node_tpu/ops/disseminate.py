@@ -179,6 +179,20 @@ def disseminate(
     # connected topic peer (main.nim:279)
     has = conns >= 0
     valid = has & neighbor_pull_bool(state.alive & state.subscribed, conns, rev)
+    # v1.1 score thresholds (nim-libp2p defaults; the reference comments the
+    # overrides out, main.nim:276-278). With the default non-negative score
+    # weights no peer can score below any threshold, so the whole block is
+    # statically absent from the compiled step.
+    thresholds_can_bind = params.slow_weight < 0.0 or params.fmd_weight < 0.0
+    if thresholds_can_bind:
+        sc = state.score(params)                       # my score of each nbr
+        pub_ok = sc >= params.publish_threshold        # flood/fanout gate
+        # graylist: the RECEIVER ignores traffic from peers it scores below
+        # the threshold — pulled to the sender side it gates DELIVERY only
+        # (the send still happens and is accounted), which is exactly the
+        # `survive` semantics shared with packet loss below
+        gray_ok = reciprocal_pull_bool(
+            sc >= params.graylist_threshold, conns, rev)
     if loss_stage is not None:
         # per-edge message loss (see docstring): the edge's stage-pair loss
         # rate, sampled once per message per directed edge. `survive` gates
@@ -189,6 +203,8 @@ def disseminate(
         survive = jax.random.uniform(k_loss, (n, c)) >= loss_edge
     else:
         survive = None
+    if thresholds_can_bind:
+        survive = gray_ok if survive is None else survive & gray_ok
     is_pub = jnp.arange(n) == publisher
     if with_fanout:
         # fanout set: still-valid unexpired members, topped back up to D
@@ -197,19 +213,31 @@ def disseminate(
         # or written back.
         fan_active = (state.fanout_mask & valid
                       & (state.fanout_expire[:, None] > t0_ms))
+        if thresholds_can_bind:
+            # the v1.1 heartbeat drops fanout members scoring below
+            # publishThreshold; checking at publish time is equivalent at
+            # the moment it matters (same treatment as replenishment)
+            fan_active = fan_active & pub_ok
         need_fan = jnp.maximum(
             float(params.d) - fan_active.sum(axis=-1).astype(jnp.float32), 0.0)
         fan_cand = valid & ~fan_active
+        if thresholds_can_bind:
+            fan_cand = fan_cand & pub_ok  # fanout selection skips low scorers
         fprio = jnp.where(fan_cand, jax.random.uniform(k_fan, (n, c)), INF)
         fan_row = fan_active | (
             fan_cand & (_ranks_f32(fprio) < need_fan[:, None]))
 
     tgt = state.mesh_mask & valid
+    flood_set = valid
+    if thresholds_can_bind:
+        # publish (flood and fanout selection) skips peers the publisher
+        # scores below publishThreshold
+        flood_set = valid & pub_ok
     if with_fanout:
-        pub_tgt = valid if params.flood_publish else fan_row
+        pub_tgt = flood_set if params.flood_publish else fan_row
         tgt = jnp.where(is_pub[:, None], pub_tgt, tgt)
     elif params.flood_publish:
-        tgt = jnp.where(is_pub[:, None], valid, tgt)
+        tgt = jnp.where(is_pub[:, None], flood_set, tgt)
 
     # randomized send order per peer (one draw per message, standing in for
     # the reference's per-peer queue service order)
@@ -222,6 +250,9 @@ def disseminate(
     # sample, so a peer missed in round h can be reached in round h+1 —
     # that re-sampling is what drives gossip recovery under loss/churn.
     g_cand = valid & ~tgt
+    if thresholds_can_bind:
+        # no IHAVE to peers scored below gossipThreshold
+        g_cand = g_cand & (sc >= params.gossip_threshold)
     n_gc = g_cand.sum(axis=-1).astype(jnp.float32)
     g_count = jnp.maximum(float(params.d_lazy), params.gossip_factor * n_gc)
     n_rounds = params.history_gossip if with_gossip else 1

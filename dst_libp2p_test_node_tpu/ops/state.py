@@ -51,6 +51,13 @@ class SimParams:
     send_queue_cap: int = 1024         # MAX_LOW_PRIORITY_QUEUE_LEN: data msgs
     # v1.1 opportunistic grafting (main.nim:292); -10000 = disabled
     opportunistic_graft_threshold: float = -10000.0
+    # v1.1 score thresholds. The reference COMMENTS these out
+    # (main.nim:276-278,306-308), deferring to nim-libp2p's defaults — which
+    # are these values. With the default non-negative score weights they can
+    # never bind and the gating is statically removed from the compiled step.
+    gossip_threshold: float = -100.0     # no IHAVE to peers scored below
+    publish_threshold: float = -1000.0   # flood/fanout skips peers below
+    graylist_threshold: float = -10000.0  # receiver ignores peers below
     proc_delay_ms: float = 2.0  # per-hop validation/processing latency
     fanout_ttl_ms: float = 60_000.0  # v1.1 fanoutTTL (libp2p default 60 s)
     max_relax_iters: int = 48   # bound on the earliest-arrival fixpoint
@@ -72,6 +79,13 @@ class SimParams:
         if self.history_gossip < 1:
             raise ValueError(
                 f"history_gossip must be >= 1, got {self.history_gossip}")
+        # the spec requires non-positive thresholds; enforcing it keeps the
+        # static can-thresholds-bind compile decision sound (scores are
+        # non-negative unless a negative weight is configured)
+        for name in ("gossip_threshold", "publish_threshold",
+                     "graylist_threshold"):
+            if getattr(self, name) > 0:
+                raise ValueError(f"{name} must be <= 0")
 
     @classmethod
     def from_gossipsub(
@@ -101,6 +115,9 @@ class SimParams:
             slow_decay=g.slow_peer_penalty_decay,
             send_queue_cap=g.max_low_priority_queue_len,
             opportunistic_graft_threshold=g.opportunistic_graft_threshold,
+            gossip_threshold=g.gossip_threshold,
+            publish_threshold=g.publish_threshold,
+            graylist_threshold=g.graylist_threshold,
             **overrides,
         )
 

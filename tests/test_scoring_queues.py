@@ -120,3 +120,78 @@ class TestOpportunisticGraft:
         np.testing.assert_array_equal(
             np.asarray(s_off.mesh_mask), np.asarray(s_on.mesh_mask))
         assert int(np.asarray(s_off.grafts).sum()) == int(np.asarray(s_on.grafts).sum())
+
+
+class TestScoreThresholds:
+    """v1.1 score thresholds (the reference defers to nim-libp2p defaults:
+    gossip -100 / publish -1000 / graylist -10000). They can only bind when
+    a negative score weight is configured; the default compile is
+    threshold-free."""
+
+    def _setup(self, **over):
+        from dst_libp2p_test_node_tpu.ops.graph import build_connection_graph
+        from dst_libp2p_test_node_tpu.ops.heartbeat import run_heartbeats
+        from dst_libp2p_test_node_tpu.ops.state import (
+            SimParams, graph_arrays, init_state,
+        )
+
+        g = build_connection_graph(40, 6, seed=1)
+        params = SimParams(n=40, capacity=g.capacity,
+                           slow_weight=-1.0, **over)
+        a = graph_arrays(g)
+        s = init_state(params, seed=1)
+        s = run_heartbeats(s, a["conns"], a["rev"], a["out_mask"], params, 8)
+        return g, params, s, a
+
+    def test_graylisted_sender_is_ignored(self):
+        from dst_libp2p_test_node_tpu.config.topology import Topology, TopoParams
+        from dst_libp2p_test_node_tpu.ops.disseminate import disseminate
+
+        g, params, s, a = self._setup(graylist_threshold=-50.0)
+        t = Topology.build(TopoParams(network_size=40, anchor_stages=1))
+        topo = (jnp.asarray(t.stage_of_peer), jnp.asarray(t.latency_ms),
+                jnp.asarray(t.bw_up_mbit))
+        # every peer scores the PUBLISHER below the graylist threshold: the
+        # slow-penalty counter lives at the receiver's slot for that edge
+        pub = 0
+        is_pub_edge = np.asarray(a["conns"]) == pub
+        slow = np.where(is_pub_edge, 100.0, 0.0).astype(np.float32)
+        s = s.replace(slow_penalty=jnp.asarray(slow))
+        res, _ = disseminate(s, a["conns"], a["rev"], *topo, publisher=pub,
+                             t0_ms=0.0, params=params, payload_bytes=15000,
+                             with_gossip=False)
+        rec = np.asarray(res.received)
+        # everyone ignores the publisher directly; nobody else has the
+        # message to relay, so it reaches nobody
+        assert rec[pub] and not rec[np.arange(40) != pub].any()
+        # the sends still happened (graylist drops at the receiver)
+        assert int(np.asarray(res.sends)[pub]) > 0
+
+    def test_default_weights_ignore_thresholds(self):
+        # with non-negative weights the compiled step contains no threshold
+        # logic: results identical whatever the threshold values are
+        from dst_libp2p_test_node_tpu.config.topology import Topology, TopoParams
+        from dst_libp2p_test_node_tpu.ops.disseminate import disseminate
+        from dst_libp2p_test_node_tpu.ops.graph import build_connection_graph
+        from dst_libp2p_test_node_tpu.ops.heartbeat import run_heartbeats
+        from dst_libp2p_test_node_tpu.ops.state import (
+            SimParams, graph_arrays, init_state,
+        )
+
+        g = build_connection_graph(40, 6, seed=1)
+        a = graph_arrays(g)
+        t = Topology.build(TopoParams(network_size=40, anchor_stages=1))
+        topo = (jnp.asarray(t.stage_of_peer), jnp.asarray(t.latency_ms),
+                jnp.asarray(t.bw_up_mbit))
+        outs = []
+        for gt in (-10000.0, -0.5):
+            params = SimParams(n=40, capacity=g.capacity,
+                               graylist_threshold=gt)
+            s = init_state(params, seed=1)
+            s = run_heartbeats(s, a["conns"], a["rev"], a["out_mask"],
+                               params, 8)
+            res, _ = disseminate(s, a["conns"], a["rev"], *topo, publisher=0,
+                                 t0_ms=0.0, params=params,
+                                 payload_bytes=15000)
+            outs.append(np.asarray(res.delay_ms))
+        np.testing.assert_array_equal(outs[0], outs[1])
