@@ -240,3 +240,44 @@ def test_fixpoint_matches_des_with_uplink_carry():
         return_plan=True)
     assert float(np.asarray(plan["uplink"]).max()) > t0
     _compare(res, plan, a["conns"], a["rev"], params, 9, t0, 1)
+
+
+def test_fixpoint_matches_des_fanout_publisher():
+    # unsubscribed publisher -> gossipsub v1.1 fanout path; the plan's tgt
+    # already resolves the fanout set, so the DES needs no special handling.
+    # flood_publish OFF so the publisher's targets really come from the
+    # fanout selection, not the flood set
+    g, params, state, a, (stage, lat, bw) = _setup(
+        128, 8, 23, 3, flood_publish=False)
+    sub = np.ones(128, bool)
+    sub[5] = False
+    state = state.replace(subscribed=jnp.asarray(sub))
+    t0 = float(state.t_ms)
+    res, _, plan = disseminate(
+        state, a["conns"], a["rev"], stage, lat, bw, publisher=5,
+        t0_ms=t0, params=params, payload_bytes=15000, with_gossip=True,
+        with_fanout=True, return_plan=True)
+    assert int(np.asarray(res.received).sum()) > 100
+    _compare(res, plan, a["conns"], a["rev"], params, 5, t0, 1)
+
+
+def test_fixpoint_matches_des_with_graylist():
+    # armed score thresholds: graylisted edges fold into the survive mask,
+    # which the plan exports — receiver-side drops must match exactly
+    g, params, state, a, (stage, lat, bw) = _setup(
+        96, 7, 24, 2, slow_weight=-1.0, graylist_threshold=-50.0)
+    # a third of the peers score peer 9 below the graylist threshold
+    rng = np.random.default_rng(5)
+    slow = np.zeros(state.slow_penalty.shape, np.float32)
+    conns = np.asarray(a["conns"])
+    rows = rng.choice(96, size=32, replace=False)
+    for r in rows:
+        slow[r, conns[r] == 9] = 100.0
+    state = state.replace(slow_penalty=jnp.asarray(slow))
+    t0 = float(state.t_ms)
+    res, _, plan = disseminate(
+        state, a["conns"], a["rev"], stage, lat, bw, publisher=9,
+        t0_ms=t0, params=params, payload_bytes=15000, with_gossip=True,
+        return_plan=True)
+    assert plan["survive"] is not None and not bool(plan["survive"].all())
+    _compare(res, plan, a["conns"], a["rev"], params, 9, t0, 1)
