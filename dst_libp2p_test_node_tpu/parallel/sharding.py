@@ -63,6 +63,32 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def reshard_rows(x, mesh: Mesh):
+    """Place one (N, ...) leaf row-sharded (host-side state swaps like
+    set_subscribed / the multi-topic uplink fold keep leaves aligned with
+    the rest of the pytree through this)."""
+    return jax.device_put(x, peer_sharding(mesh))
+
+
+def place_simulation(state, arrays: dict, stage, lat, bw, loss, mesh: Mesh):
+    """Constructor-side placement shared by the single- and multi-topic
+    simulators: row-axis divisibility check, then shard state/graph/topology
+    (rows sharded, the tiny stage matrices replicated). Returns
+    (state, arrays, stage, lat, bw, loss)."""
+    n_rows = state.mesh_mask.shape[0]
+    if n_rows % mesh.devices.size != 0:
+        raise ValueError(
+            f"peer rows {n_rows} must divide evenly over "
+            f"{mesh.devices.size} devices"
+        )
+    topo = {"stage": stage, "lat": lat, "bw": bw}
+    if loss is not None:
+        topo["loss"] = loss
+    state, arrays, topo = shard_simulation(state, arrays, topo, mesh)
+    return (state, arrays, topo["stage"], topo["lat"], topo["bw"],
+            topo.get("loss"))
+
+
 def shard_simulation(state, arrays: dict, topo: dict, mesh: Mesh):
     """Place SimState + graph/topology arrays: peer-major rows sharded,
     scalars/clock/key and the tiny stage matrices replicated."""

@@ -95,9 +95,16 @@ class _TopicStateView:
 class MultiTopicSimulator:
     """T topics over one shared connection graph, stacked as virtual peers."""
 
-    def __init__(self, cfg: MultiTopicConfig, topology: Topology | None = None):
+    def __init__(self, cfg: MultiTopicConfig, topology: Topology | None = None,
+                 mesh=None):
+        """`mesh`: optional 1-D jax.sharding.Mesh over the (virtual) peer
+        axis — the T*N stacked rows shard across its devices exactly like
+        the single-topic Simulator's rows, and every publish runs the
+        explicit shard_map + ICI collective fixpoint. T*network_size must
+        divide evenly by the device count."""
         cfg.validate()
         self.cfg = cfg
+        self.mesh = mesh
         self.topology = topology or Topology.build(cfg.topo)
         n = cfg.topo.network_size
         tcount = len(cfg.topics)
@@ -149,6 +156,13 @@ class MultiTopicSimulator:
         self.state = self.state.replace(
             subscribed=jnp.asarray(self.subscribed_np.reshape(-1)),
             hb_phase=jnp.asarray(np.tile(phase_node, tcount)))
+        if mesh is not None:
+            from ..parallel.sharding import place_simulation
+
+            (self.state, self.arrays, self._stage, self._lat, self._bw,
+             self._loss) = place_simulation(
+                self.state, self.arrays, self._stage, self._lat, self._bw,
+                self._loss, mesh)
         self._hb_carry_ms = 0.0
         self.records: list[tuple[str, MessageRecord]] = []
         self._msg_rng = np.random.default_rng(cfg.seed ^ 0x6D736749)
@@ -202,6 +216,7 @@ class MultiTopicSimulator:
             params=self.params, payload_bytes=size,
             fragments=self.cfg.topo.num_frags,
             with_gossip=self.cfg.with_gossip,
+            mesh=self.mesh,
             loss_stage=self._loss,
             with_fanout=not bool(self.subscribed_np[ti][publisher]),
         )
@@ -213,8 +228,13 @@ class MultiTopicSimulator:
         t_ct = len(self.cfg.topics)
         if t_ct > 1:
             u_node = self.state.uplink_free_ms.reshape(t_ct, n).max(axis=0)
-            self.state = self.state.replace(
-                uplink_free_ms=jnp.tile(u_node, t_ct))
+            u_all = jnp.tile(u_node, t_ct)
+            if self.mesh is not None:
+                # keep the leaf row-sharded like the rest of the state
+                from ..parallel.sharding import reshard_rows
+
+                u_all = reshard_rows(u_all, self.mesh)
+            self.state = self.state.replace(uplink_free_ms=u_all)
         blk = slice(ti * n, (ti + 1) * n)
 
         class _Blk:  # the topic's N-row window of the stacked result

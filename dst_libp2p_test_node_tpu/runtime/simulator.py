@@ -182,23 +182,12 @@ class Simulator:
             if float(np.max(self.topology.packet_loss)) > 0.0 else None
         )
         if mesh is not None:
-            from ..parallel.sharding import shard_simulation
+            from ..parallel.sharding import place_simulation
 
-            if n % mesh.devices.size != 0:
-                raise ValueError(
-                    f"network_size {n} must divide evenly over "
-                    f"{mesh.devices.size} devices"
-                )
-            topo_arrs = {"stage": self._stage, "lat": self._lat, "bw": self._bw}
-            if self._loss is not None:
-                topo_arrs["loss"] = self._loss
-            self.state, self.arrays, topo_arrs = shard_simulation(
-                self.state, self.arrays, topo_arrs, mesh
-            )
-            self._stage, self._lat, self._bw = (
-                topo_arrs["stage"], topo_arrs["lat"], topo_arrs["bw"]
-            )
-            self._loss = topo_arrs.get("loss")
+            (self.state, self.arrays, self._stage, self._lat, self._bw,
+             self._loss) = place_simulation(
+                self.state, self.arrays, self._stage, self._lat, self._bw,
+                self._loss, mesh)
         # host mirror of state.subscribed: publish() picks the fanout code
         # path (static arg) without a device sync; keep in sync via
         # set_subscribed()
@@ -229,11 +218,9 @@ class Simulator:
         sub = jnp.asarray(mask)
         if self.mesh is not None:
             # keep the leaf row-sharded like the rest of the state pytree
-            import jax
+            from ..parallel.sharding import reshard_rows
 
-            from ..parallel.sharding import peer_sharding
-
-            sub = jax.device_put(sub, peer_sharding(self.mesh))
+            sub = reshard_rows(sub, self.mesh)
         self.state = self.state.replace(subscribed=sub)
 
     def advance(self, ms: float) -> None:

@@ -52,11 +52,60 @@ def test_sharded_fragments_unrolled():
     np.testing.assert_allclose(ra.delays_ms, rb.delays_ms, rtol=1e-5)
 
 
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 (virtual) devices")
 def test_uneven_shard_rejected():
     with pytest.raises(ValueError):
         Simulator(
             ExperimentConfig(
                 topo=TopoParams(network_size=60), connect_to=6
             ),
+            mesh=make_peer_mesh(8),
+        )
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 (virtual) devices")
+def test_sharded_multitopic_matches_single_device():
+    # the EP analog sharded: T*N virtual-peer rows across the mesh; two
+    # topics published back-to-back so the cross-topic uplink fold also
+    # runs on sharded state
+    from dst_libp2p_test_node_tpu.runtime.multitopic import (
+        MultiTopicConfig, MultiTopicSimulator,
+    )
+
+    def cfg():
+        return MultiTopicConfig(
+            topo=TopoParams(network_size=48, anchor_stages=2,
+                            min_bandwidth=50, max_bandwidth=100,
+                            min_latency=40, max_latency=80,
+                            msg_size_bytes=15000),
+            topics=("blocks", "attestations"), connect_to=6,
+            subscribe_fraction=0.8, warmup_s=3.0, seed=11,
+        )
+
+    a = MultiTopicSimulator(cfg())
+    a.warmup()
+    ra1 = a.publish("blocks", 7)
+    ra2 = a.publish("attestations", 7)
+
+    b = MultiTopicSimulator(cfg(), mesh=make_peer_mesh(8))
+    b.warmup()
+    rb1 = b.publish("blocks", 7)
+    rb2 = b.publish("attestations", 7)
+
+    for ra, rb in ((ra1, rb1), (ra2, rb2)):
+        np.testing.assert_array_equal(ra.received, rb.received)
+        np.testing.assert_allclose(ra.delays_ms, rb.delays_ms, rtol=1e-5)
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 (virtual) devices")
+def test_sharded_multitopic_uneven_rejected():
+    from dst_libp2p_test_node_tpu.runtime.multitopic import (
+        MultiTopicConfig, MultiTopicSimulator,
+    )
+
+    with pytest.raises(ValueError):
+        MultiTopicSimulator(
+            MultiTopicConfig(topo=TopoParams(network_size=30),
+                             topics=("a", "b", "c"), connect_to=6),
             mesh=make_peer_mesh(8),
         )
