@@ -453,6 +453,7 @@ def disseminate(
     t_rx = jnp.where(received, t_rx_f.max(axis=0), INF)  # last fragment completes
     delay = jnp.where(received, t_rx - t0_ms, INF)
 
+
     # ---- post-fixpoint accounting (bytes, duplicates, gossip, score) -------
     def frag_accounting(frag_idx, t_rx_one, rank, k_p, send_mask):
         # tx side (sends, bytes): everything transmitted, lost or not
@@ -560,6 +561,22 @@ def disseminate(
     credit = (jnp.arange(c) == fs[:, None]) & got[:, None]
     fmd = jnp.minimum(state.fmd + credit.astype(jnp.float32), params.fmd_cap)
 
+    # IDONTWANT control-message counters (v1.2, go-test-node/main.go:165):
+    # on first RECEIPT of a large message a peer announces IDONTWANT to its
+    # mesh members except the one that delivered it — once per MESSAGE, not
+    # per fragment; the publisher announces nothing (it received nothing).
+    # The suppression effect rides inside frag_accounting; this is the
+    # announce traffic. `credit` is exactly the first-delivery back-edge.
+    if payload_bytes >= params.idontwant_threshold_bytes:
+        idw_edge = (state.mesh_mask & valid & ~credit
+                    & (got & can_send)[:, None])
+        idw_tx_pp = idw_edge.sum(axis=-1).astype(jnp.int32)
+        idw_rx_pp = reciprocal_pull_bool(
+            idw_edge, conns, rev).sum(axis=-1).astype(jnp.int32)
+    else:
+        idw_tx_pp = jnp.zeros((n,), jnp.int32)
+        idw_rx_pp = jnp.zeros((n,), jnp.int32)
+
     result = DisseminationResult(
         t_rx_ms=t_rx,
         delay_ms=delay,
@@ -593,6 +610,8 @@ def disseminate(
         iwant_tx=state.iwant_tx + iwant_pp,
         ihave_rx=state.ihave_rx + ihave_rx_pp,
         iwant_rx=state.iwant_rx + iwant_rx_pp,
+        idontwant_tx=state.idontwant_tx + idw_tx_pp,
+        idontwant_rx=state.idontwant_rx + idw_rx_pp,
     )
     if with_fanout:
         # persist the publisher's (possibly replenished) fanout set and

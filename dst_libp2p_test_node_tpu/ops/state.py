@@ -170,6 +170,9 @@ class SimState:
     iwant_tx: jnp.ndarray      # (N,) int32 IWANT requests sent
     ihave_rx: jnp.ndarray      # (N,) int32 IHAVE announcements received
     iwant_rx: jnp.ndarray      # (N,) int32 IWANT requests received
+    idontwant_tx: jnp.ndarray  # (N,) int32 IDONTWANTs sent (v1.2: on first
+    #                            receipt of a large message, to mesh peers)
+    idontwant_rx: jnp.ndarray  # (N,) int32 IDONTWANTs received
 
     def score(self, params: SimParams) -> jnp.ndarray:
         """Peer score as seen across each directed edge (v1.1 subset:
@@ -211,6 +214,8 @@ def init_state(params: SimParams, seed: int = 0) -> SimState:
         iwant_tx=jnp.zeros((n,), dtype=jnp.int32),
         ihave_rx=jnp.zeros((n,), dtype=jnp.int32),
         iwant_rx=jnp.zeros((n,), dtype=jnp.int32),
+        idontwant_tx=jnp.zeros((n,), dtype=jnp.int32),
+        idontwant_rx=jnp.zeros((n,), dtype=jnp.int32),
     )
 
 

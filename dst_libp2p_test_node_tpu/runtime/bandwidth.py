@@ -58,9 +58,11 @@ class PeerTraffic:
         rx = np.asarray(state.bytes_rx, dtype=np.float64)
         tx = np.asarray(state.bytes_tx, dtype=np.float64)
         ctrl_tx = (np.asarray(state.ihave_tx, dtype=np.float64)
-                   + np.asarray(state.iwant_tx, dtype=np.float64))
+                   + np.asarray(state.iwant_tx, dtype=np.float64)
+                   + np.asarray(state.idontwant_tx, dtype=np.float64))
         ctrl_rx = (np.asarray(state.ihave_rx, dtype=np.float64)
-                   + np.asarray(state.iwant_rx, dtype=np.float64))
+                   + np.asarray(state.iwant_rx, dtype=np.float64)
+                   + np.asarray(state.idontwant_rx, dtype=np.float64))
         return cls(rx_bytes=rx, tx_bytes=tx, ctrl_rx=ctrl_rx, ctrl_tx=ctrl_tx)
 
 

@@ -372,10 +372,14 @@ class NodeMetrics:
         iwant_tx = np.asarray(st.iwant_tx)
         ihave_rx = np.asarray(st.ihave_rx)
         iwant_rx = np.asarray(st.iwant_rx)
+        idw_tx = np.asarray(st.idontwant_tx)
+        idw_rx = np.asarray(st.idontwant_rx)
         self.broadcast_ihave.set(float(sum(ihave_tx[r] for r in rows)))
         self.broadcast_iwant.set(float(sum(iwant_tx[r] for r in rows)))
         self.received_ihave.set(float(sum(ihave_rx[r] for r in rows)))
         self.received_iwant.set(float(sum(iwant_rx[r] for r in rows)))
+        self.broadcast_idontwant.set(float(sum(idw_tx[r] for r in rows)))
+        self.received_idontwant.set(float(sum(idw_rx[r] for r in rows)))
         self.duplicates.set(float(sum(dup[r] for r in rows)))
 
     def render(self) -> str:

@@ -122,11 +122,13 @@ def test_from_state_per_peer_ctrl():
         iwant_tx = np.array([0, 3, 0])
         ihave_rx = np.array([0, 2, 2])
         iwant_rx = np.array([3, 0, 1])
+        idontwant_tx = np.array([0, 0, 5])
+        idontwant_rx = np.array([2, 2, 1])
 
     t = PeerTraffic.from_state(FakeState)
     # ctrl counters are REAL per-peer values, not an even spread
-    assert (t.ctrl_tx == np.array([4.0, 3.0, 0.0])).all()
-    assert (t.ctrl_rx == np.array([3.0, 2.0, 3.0])).all()
+    assert (t.ctrl_tx == np.array([4.0, 3.0, 5.0])).all()
+    assert (t.ctrl_rx == np.array([5.0, 4.0, 4.0])).all()
     assert (t.rx_bytes == FakeState.bytes_rx).all()
 
 

@@ -145,6 +145,30 @@ def test_gossip_only_dissemination():
     assert int(np.asarray(s2.ihave_tx).sum()) == int(np.asarray(s2.ihave_rx).sum())
 
 
+def test_idontwant_counters():
+    g, params, state, a, (stage, lat, bw) = mesh_setup()
+    # large message: every RECEIVER announces IDONTWANT to its mesh members
+    # except the one it received from; the publisher announces nothing
+    res, s2 = disseminate(state, a["conns"], a["rev"], stage, lat, bw,
+                          publisher=0, t0_ms=float(state.t_ms),
+                          params=params, payload_bytes=15000)
+    tx = np.asarray(s2.idontwant_tx)
+    rx = np.asarray(s2.idontwant_rx)
+    assert tx.sum() > 0 and tx.sum() == rx.sum()   # conservation
+    assert tx[0] == 0                              # publisher receives nothing
+    mesh_deg = np.asarray(state.mesh_mask).sum(-1)
+    # each receiver: mesh degree, minus 1 when its first sender is one of
+    # its mesh members (the flood publisher may deliver over a non-mesh edge)
+    diff = mesh_deg[1:] - tx[1:]
+    assert ((diff == 0) | (diff == 1)).all()
+    assert (diff == 1).any()
+    # small message: below the v1.2 threshold no IDONTWANT is sent
+    _, s3 = disseminate(state, a["conns"], a["rev"], stage, lat, bw,
+                        publisher=0, t0_ms=float(state.t_ms),
+                        params=params, payload_bytes=500)
+    assert int(np.asarray(s3.idontwant_tx).sum()) == 0
+
+
 def test_multi_round_gossip_recovers_lossy_edges():
     # 20% per-edge message loss, gossip-only transport (empty mesh, no
     # flood): the mcache window re-samples IHAVE targets every heartbeat
