@@ -495,32 +495,41 @@ def disseminate(
                 ) + lat_edge
                 gossip_sent = gossip_sent | (active_h & lacked_h)
             ihave_pp = ihave_ct.sum(axis=-1)            # (N,) IHAVEs sent
-            # IHAVEs received: pull the per-edge counts through the involution
-            slot_ok = (conns >= 0) & (rev >= 0)
-            ihave_rx_pp = jnp.where(
-                slot_ok,
-                reciprocal_pull_min(ihave_ct, conns, rev,
-                                    batch_factor=fragments),
-                0.0,
-            ).sum(axis=-1)
             # the IWANT flows opposite the IHAVE: the lacking RECEIVER sends
             # it, the gossiping peer receives it
             iwant_rx_pp = gossip_sent.sum(axis=-1).astype(jnp.float32)
-            iwant_pp = reciprocal_pull_bool(
-                gossip_sent, conns, rev, batch_factor=fragments
-            ).sum(axis=-1).astype(jnp.float32)
             sends = sends + (gossip_sent & made_offer).sum(axis=-1)
             sent_any = (made_offer & send_mask) | (gossip_sent & made_offer)
+            arrived = sent_any if survive is None else sent_any & survive
+            # ONE pull for all three involution-crossing quantities: the
+            # per-edge IHAVE count (<= history_gossip), the IWANT flag and
+            # the delivered-copy flag pack exactly into one small float —
+            # every extra pull is a full row-gather pass (ops/pull.py), so
+            # 3 -> 1 saves two passes per fragment
+            pack = (ihave_ct * 4.0 + gossip_sent.astype(jnp.float32) * 2.0
+                    + arrived.astype(jnp.float32))
+            slot_ok = (conns >= 0) & (rev >= 0)
+            pulled = jnp.where(
+                slot_ok,
+                reciprocal_pull_min(pack, conns, rev, batch_factor=fragments),
+                0.0)
+            q_ihave = jnp.floor(pulled / 4.0)
+            rem = pulled - q_ihave * 4.0
+            q_gs = jnp.floor(rem / 2.0)
+            ihave_rx_pp = q_ihave.sum(axis=-1)
+            iwant_pp = q_gs.sum(axis=-1)
+            copies = (rem - q_gs * 2.0).sum(axis=-1)
         else:
             ihave_pp = jnp.zeros((n,), jnp.float32)
             iwant_pp = jnp.zeros((n,), jnp.float32)
             ihave_rx_pp = jnp.zeros((n,), jnp.float32)
             iwant_rx_pp = jnp.zeros((n,), jnp.float32)
             sent_any = made_offer & send_mask
-        # receivers only count copies the network actually delivered
-        arrived = sent_any if survive is None else sent_any & survive
-        copies = reciprocal_pull_bool(
-            arrived, conns, rev, batch_factor=fragments).sum(axis=-1)
+            # receivers only count copies the network actually delivered
+            arrived = sent_any if survive is None else sent_any & survive
+            copies = reciprocal_pull_bool(
+                arrived, conns, rev, batch_factor=fragments
+            ).sum(axis=-1)
         # slow-peer penalty (main.nim:264-299): deliveries that spent longer
         # than the threshold in the SENDER's queue mark the sender as slow
         # in the RECEIVER's score of it (the reciprocal slot) — scoring and
