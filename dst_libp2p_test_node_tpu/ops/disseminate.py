@@ -7,13 +7,19 @@ aggregates (shadow/summary_latency*.awk). Shadow produces those delays with a
 full per-packet discrete-event simulation; we produce them as the fixpoint of
 
     t_rx[q] = min over senders p of
-        t_rx[p] + proc + (rank_p(q)+1) * tx_p + LAT[stage_p, stage_q]
+        max(t_rx[p] + proc, uplink_free[p])
+        + (rank_p(q)+1) * tx_p + LAT[stage_p, stage_q]
 
 where rank_p(q) is q's position in p's randomized send order (uplink
 serialization: a peer forwarding B bytes to k mesh members occupies its own
 uplink k times in sequence — Shadow's dominant queueing effect for 15 KB
-messages, acknowledged by summary_latency_large.awk:20-24), and LAT is the
-stage-pair latency matrix from the topology.
+messages, acknowledged by summary_latency_large.awk:20-24), LAT is the
+stage-pair latency matrix from the topology, and uplink_free carries the
+drain time of EARLIER messages (SimState): concurrent publishes queue
+behind each other the way the reference's per-connection queues serialize
+all in-flight traffic. The whole model is differentially validated against
+an independent host-side event-queue simulator
+(tests/test_des_crosscheck.py).
 
 The iteration is a *pull*: each peer gathers its neighbors' sender-side
 candidate times through the reverse-slot map (ops/graph.py) — two gathers and
@@ -24,11 +30,16 @@ first sender, then again with the back-edge removed from the send order (the
 reference never forwards a message back to the peer that delivered it, so
 that uplink slot is never occupied).
 
-IHAVE/IWANT gossip joins the same fixpoint as extra candidate edges quantized
-to the emitter's next heartbeat tick (IHAVE -> IWANT -> message = 3 link
-traversals + one serialization). Post-fixpoint, a single accounting pass
-yields duplicate deliveries, per-peer tx/rx bytes, IHAVE/IWANT counts,
-IDONTWANT suppression (go-test-node/main.go:165), and
+IHAVE/IWANT gossip joins the same fixpoint as extra candidate edges
+quantized to the emitter's heartbeat ticks (IHAVE -> IWANT -> message =
+3 link traversals + one serialization). Targets re-sample EVERY heartbeat
+over the mcache history window (history_gossip rounds, main.nim:259,283);
+since each round's offer grows by one heartbeat, the window collapses to a
+per-edge first-sampled-round offset inside the fixpoint. Heartbeat phases
+are persistent per-node state. Post-fixpoint, a single accounting pass
+yields duplicate deliveries, per-peer tx/rx bytes, per-peer bidirectional
+IHAVE/IWANT/IDONTWANT counts, IDONTWANT suppression
+(go-test-node/main.go:165), v1.1 score-threshold gating, and
 firstMessageDeliveries score credit.
 
 Fragmentation (FRAGMENTS > 1, main.nim:177-179) vmaps everything over the
