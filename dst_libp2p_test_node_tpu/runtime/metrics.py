@@ -380,6 +380,17 @@ class NodeMetrics:
         self.received_iwant.set(float(sum(iwant_rx[r] for r in rows)))
         self.broadcast_idontwant.set(float(sum(idw_tx[r] for r in rows)))
         self.received_idontwant.set(float(sum(idw_rx[r] for r in rows)))
+        # SUBSCRIBE control messages fire once per (peer, joined topic) at
+        # startup and are broadcast to every connected peer (the Go tracer
+        # counts both directions); project them from the subscription state
+        sub_np = (np.asarray(sim.subscribed_np) if multitopic
+                  # host mirror maintained by set_subscribed — no device sync
+                  else np.asarray(sim._subscribed_np)[None, :])
+        n_sub_self = int(sub_np[:, peer_id].sum())
+        nbrs = sim.graph.conns[peer_id]
+        nbrs = nbrs[nbrs >= 0]
+        self.broadcast_subscriptions.set(float(n_sub_self * len(nbrs)))
+        self.received_subscriptions.set(float(sub_np[:, nbrs].sum()))
         self.duplicates.set(float(sum(dup[r] for r in rows)))
 
     def render(self) -> str:

@@ -315,3 +315,31 @@ class TestMetricsProjection:
         assert m.no_peers_topics.get() == 0
         assert m.low_peers_topics.get() == 0
         assert m.healthy_peers_topics.get() == 0
+
+    def test_subscription_counters_projected(self):
+        # SUBSCRIBE control messages: one per joined topic to every
+        # connected peer; received = neighbors' joined-topic announcements
+        import numpy as np
+
+        from dst_libp2p_test_node_tpu.config.topology import TopoParams
+        from dst_libp2p_test_node_tpu.runtime.multitopic import (
+            MultiTopicConfig, MultiTopicSimulator,
+        )
+
+        cfg = MultiTopicConfig(
+            topo=TopoParams(network_size=32, anchor_stages=1,
+                            msg_size_bytes=500),
+            topics=("a", "b"), connect_to=6,
+            subscribe_fraction=0.7, warmup_s=5.0, seed=1,
+        )
+        sim = MultiTopicSimulator(cfg)
+        sim.warmup()
+        peer = int(np.nonzero(sim.subscribed_np.any(axis=0))[0][0])
+        m = NodeMetrics(peer_id=str(peer))
+        m.fill_from_sim(sim, peer)
+        nbrs = sim.graph.conns[peer]
+        nbrs = nbrs[nbrs >= 0]
+        want_tx = int(sim.subscribed_np[:, peer].sum()) * len(nbrs)
+        want_rx = int(sim.subscribed_np[:, nbrs].sum())
+        assert m.broadcast_subscriptions.get() == want_tx
+        assert m.received_subscriptions.get() == want_rx
