@@ -125,14 +125,19 @@ def _remove_first_sender(m: _Model, t1, publisher, send_mask, rank, k, frag):
     return removed
 
 
-def des_delays(conns, rev, plan, params, publisher, t0_ms, fragments):
+def des_delays(conns, rev, plan, params, publisher, t0_ms, fragments,
+               return_uplink=False):
     """Full DES: per fragment, two Dijkstra phases; message completes at a
-    receiver when its last fragment lands."""
+    receiver when its last fragment lands. With `return_uplink`, also
+    computes each sender's post-message uplink drain time independently
+    (fragment f's last send finishes (f+1)*k_f serialization slots after
+    its start) to cross-check the engine's occupancy write-back."""
     m = _Model(conns, rev, plan, params)
     tgt = np.asarray(plan["tgt"])
     rprio = np.asarray(plan["rprio"], np.float64)
     t_pubs = np.asarray(plan["t_pubs"], np.float64)
     t_frags = []
+    uplink_new = m.up.copy()
     for f in range(fragments):
         tgt_f = tgt.copy()
         if params.send_queue_cap < fragments and f + 1 > params.send_queue_cap:
@@ -141,6 +146,7 @@ def des_delays(conns, rev, plan, params, publisher, t0_ms, fragments):
         rank1 = _ranks(rprio, tgt_f)
         k1 = tgt_f.sum(axis=-1).astype(np.float64)
         t1 = _dijkstra(m, publisher, t_pubs[f], tgt_f, rank1, k1, f)
+        k_f = k1
         if params.exclude_first_sender:
             removed = _remove_first_sender(
                 m, t1, publisher, tgt_f, rank1, k1, f)
@@ -148,11 +154,21 @@ def des_delays(conns, rev, plan, params, publisher, t0_ms, fragments):
             rank2 = _ranks(rprio, send2)
             k2 = send2.sum(axis=-1).astype(np.float64)
             t1 = _dijkstra(m, publisher, t_pubs[f], send2, rank2, k2, f)
+            k_f = k2
+        if return_uplink:
+            for p in range(m.n):
+                if k_f[p] > 0 and t1[p] < INF_CUT and m.can[p]:
+                    start = max(t1[p] + m.proc, m.up[p])
+                    uplink_new[p] = max(
+                        uplink_new[p], start + (f + 1.0) * k_f[p] * m.tx[p])
         t_frags.append(t1)
     t_all = np.stack(t_frags)
     received = (t_all < INF_CUT).all(axis=0)
     t_rx = np.where(received, t_all.max(axis=0), math.inf)
-    return np.where(received, t_rx - t0_ms, math.inf), received
+    delays = np.where(received, t_rx - t0_ms, math.inf)
+    if return_uplink:
+        return delays, received, uplink_new
+    return delays, received
 
 
 def _setup(n, connect_to, seed, stages, hb_steps=8, **over):
@@ -225,15 +241,23 @@ def test_fixpoint_matches_des(n, ct, seed, stages, frags, loss, flood,
     _compare(res, plan, a["conns"], a["rev"], params, pub, t0, frags)
 
 
-def test_fixpoint_matches_des_with_uplink_carry():
-    # second message published back-to-back: the plan carries nonzero
-    # uplink occupancy from message 1, which the DES must honor identically
+@pytest.mark.parametrize("frags", [1, 3])
+def test_fixpoint_matches_des_with_uplink_carry(frags):
+    # message 1's occupancy WRITE-BACK is recomputed independently by the
+    # DES and must equal the engine's; message 2 then reads it — both sides
+    # of the cross-message coupling cross-checked, incl. multi-fragment
     g, params, state, a, (stage, lat, bw) = _setup(128, 8, 21, 4)
     t0 = float(state.t_ms)
-    _, s1 = disseminate(
+    r1, s1, plan1 = disseminate(
         state, a["conns"], a["rev"], stage, lat, bw, publisher=3,
-        t0_ms=t0, params=params, payload_bytes=15000, with_gossip=True)
-    assert float(np.asarray(s1.uplink_free_ms).max()) > t0
+        t0_ms=t0, params=params, payload_bytes=15000, fragments=frags,
+        with_gossip=True, return_plan=True)
+    _, _, want_up = des_delays(
+        np.asarray(a["conns"]), np.asarray(a["rev"]), plan1, params, 3, t0,
+        frags, return_uplink=True)
+    got_up = np.asarray(s1.uplink_free_ms, np.float64)
+    assert float(got_up.max()) > t0
+    np.testing.assert_allclose(got_up, want_up, rtol=1e-4, atol=0.5)
     res, _, plan = disseminate(
         s1, a["conns"], a["rev"], stage, lat, bw, publisher=9,
         t0_ms=t0, params=params, payload_bytes=15000, with_gossip=True,
