@@ -6,9 +6,10 @@ The reference measures one thing above all: per-message dissemination latency
 aggregates (shadow/summary_latency*.awk). Shadow produces those delays with a
 full per-packet discrete-event simulation; we produce them as the fixpoint of
 
-    t_rx[q] = min over senders p of
-        max(t_rx[p] + proc, uplink_free[p])
-        + (rank_p(q)+1) * tx_p + LAT[stage_p, stage_q]
+    t_rx[q] = max( min over senders p of
+                     max(t_rx[p] + proc, uplink_free[p])
+                     + (rank_p(q)+1) * tx_p + LAT[stage_p, stage_q],
+                   rx_free[q] + rx_ms[q] )
 
 where rank_p(q) is q's position in p's randomized send order (uplink
 serialization: a peer forwarding B bytes to k mesh members occupies its own
@@ -17,9 +18,24 @@ messages, acknowledged by summary_latency_large.awk:20-24), LAT is the
 stage-pair latency matrix from the topology, and uplink_free carries the
 drain time of EARLIER messages (SimState): concurrent publishes queue
 behind each other the way the reference's per-connection queues serialize
-all in-flight traffic. The whole model is differentially validated against
-an independent host-side event-queue simulator
-(tests/test_des_crosscheck.py).
+all in-flight traffic.
+
+The outer max is the RECEIVER side of the same bandwidth story: Shadow
+enforces host_bandwidth_down on every host (shadow/topogen.py:50-51), so a
+copy of rx_ms[q] = bytes/bw_down drain time arriving while q's downlink is
+still busy with earlier traffic completes only when that backlog clears
+plus its own drain — the single-server queue completion
+max(wire_arrival, busy_until + rx_ms). When the downlink is idle the copy
+streams through concurrently with the sender's serialization (bw_down ==
+bw_up per stage in the reference topology) and completes at its wire
+arrival: no double-counted serialization. rx_free is carried in SimState
+(write-back below folds ALL delivered copies — duplicates and gossip
+answers included — through the queue in arrival order, exactly).
+Cross-fragment rx contention inside one message is not modeled: same-sender
+fragments are spaced k*tx >= rx_ms apart by the uplink queue, so only
+interleaved different-sender duplicates could bind, a second-order effect.
+The whole model is differentially validated against an independent
+host-side event-queue simulator (tests/test_des_crosscheck.py).
 
 The iteration is a *pull*: each peer gathers its neighbors' sender-side
 candidate times through the reverse-slot map (ops/graph.py) — two gathers and
@@ -116,6 +132,7 @@ def disseminate(
     loss_stage=None,
     with_fanout: bool = False,
     return_plan: bool = False,
+    bw_down_mbit_per_stage=None,
 ):
     """Propagate one application message (all fragments) through the mesh.
 
@@ -170,6 +187,16 @@ def disseminate(
 
     frag_bytes = max(payload_bytes // fragments, 16)
     tx_ms = (frag_bytes * 8.0) / (bw_up_mbit_per_stage[stage] * 1e6) * 1e3  # (N,)
+    # receiver-side drain time of one copy on each peer's downlink. The
+    # reference topology sets host_bandwidth_down == host_bandwidth_up per
+    # stage (shadow/topogen.py:50-51); pass bw_down_mbit_per_stage to model
+    # asymmetric links.
+    bw_down = (bw_up_mbit_per_stage if bw_down_mbit_per_stage is None
+               else bw_down_mbit_per_stage)
+    rx_ms = (frag_bytes * 8.0) / (bw_down[stage] * 1e6) * 1e3          # (N,)
+    # downlink clamp for THIS message's first delivery: nothing completes at
+    # q before q's downlink drains earlier messages plus this copy
+    rx_const = state.rx_free_ms + rx_ms                                # (N,)
 
     # per-slot link latency lat[stage[p], stage[conns[p,i]]]. The naive
     # 2-index form costs ~60 ms at 100k (scalar gathers); instead: row-gather
@@ -349,7 +376,7 @@ def disseminate(
             # psum per iteration over ICI (parallel/exchange.py)
             c = build_recv_constants(
                 conns, rev, lat_edge, tx_ms, rank, k_p, frag_idx, deliver,
-                can_send, g_deliver, g_off, hb_phase, uplink,
+                can_send, g_deliver, g_off, hb_phase, uplink, rx_const,
                 params.proc_delay_ms, params.heartbeat_ms, with_gossip,
             )
             return converge_sharded(t0, c, params.max_relax_iters, mesh)
@@ -363,7 +390,7 @@ def disseminate(
             # sharded path runs.
             c = build_recv_constants(
                 conns, rev, lat_edge, tx_ms, rank, k_p, frag_idx, deliver,
-                can_send, g_deliver, g_off, hb_phase, uplink,
+                can_send, g_deliver, g_off, hb_phase, uplink, rx_const,
                 params.proc_delay_ms, params.heartbeat_ms, with_gossip,
             )
             return converge_recv(t0, c, params.max_relax_iters)
@@ -394,7 +421,10 @@ def disseminate(
                     jnp.where(live,
                               jnp.maximum(hb[:, None] + g_off,
                                           uplink[:, None]) + g_base, INF))
-            t_new = jnp.minimum(t_rx, pull(cand).min(axis=-1))
+            # downlink clamp (max distributes over the row min, so clamping
+            # the min equals clamping every candidate)
+            t_new = jnp.minimum(
+                t_rx, jnp.maximum(pull(cand).min(axis=-1), rx_const))
             return t_new, jnp.any(t_new < t_rx), it + 1
 
         t_rx, _, _ = jax.lax.while_loop(cond, body, (t0, jnp.bool_(True), 0))
@@ -437,8 +467,11 @@ def disseminate(
         # term keeps the tolerance above the f32 ulp at large sim times; a
         # generous value is safe — the only peers whose min offer truly
         # exceeds t1 are unreached ones (INF on both sides)
+        # (t1 < INF) makes the reached-peer precondition explicit: for
+        # unreached peers INF <= INF + eps is vacuously true and would strip
+        # a phantom back-edge at slot 0
         got_remote = (inc1.min(axis=-1) <= t1 + 0.01 + 1e-5 * t1) \
-            & (jnp.arange(n) != publisher)
+            & (t1 < INF) & (jnp.arange(n) != publisher)
         # row-wise one-hot via fused iota compare (scatters serialize on TPU)
         back = (jnp.arange(c) == first_slot[:, None]) & got_remote[:, None]
         send_mask = tgt_f & ~back
@@ -483,7 +516,18 @@ def disseminate(
             )[:, None] + (rank + frag_idx * k_p[:, None]) * tx_ms[:, None]
             idw_arrived = q_t + lat_edge < send_start
             made_offer = made_offer & ~(idw_arrived & send_mask)
-        sends = (made_offer & send_mask).sum(axis=-1)
+        eff_send = made_offer & send_mask
+        sends = eff_send.sum(axis=-1)
+        # uplink occupancy of this fragment's mesh sends: the queue drains at
+        # the end of the LAST slot actually transmitted. Slot positions stay
+        # fixed when an IDONTWANT suppresses an earlier send (the delivery
+        # model keeps static ranks), so only trailing suppressed slots
+        # shorten the drain.
+        start_tx = jnp.maximum(t_rx_one + params.proc_delay_ms, uplink)
+        last_pos = jnp.max(jnp.where(eff_send, rank + 1.0, 0.0), axis=-1)
+        up_end = jnp.where(
+            last_pos > 0.0,
+            start_tx + (frag_idx * k_p + last_pos) * tx_ms, 0.0)
         if with_gossip:
             havers = (t_rx_one < INF) & can_send
             hb = _next_heartbeat(
@@ -501,16 +545,29 @@ def disseminate(
                 ihave_ct = ihave_ct + active_h
                 # the announce leaves when the tick fires AND the sender's
                 # uplink has drained — same clamp the fixpoint applies
-                lacked_h = q_t > jnp.maximum(
-                    hb[:, None] + h * params.heartbeat_ms, uplink[:, None]
-                ) + lat_edge
-                gossip_sent = gossip_sent | (active_h & lacked_h)
+                ans_start_h = jnp.maximum(
+                    hb[:, None] + h * params.heartbeat_ms, uplink[:, None])
+                ans_h = active_h & (q_t > ans_start_h + lat_edge)
+                if survive is not None:
+                    # a graylisted/lossy edge never delivers the IHAVE, so no
+                    # IWANT comes back and no answer is transmitted — the
+                    # control/byte accounting matches the fixpoint's
+                    # g_deliver = g_tgt & survive delivery gating
+                    ans_h = ans_h & survive
+                gossip_sent = gossip_sent | ans_h
+                # the answer serializes on the answering uplink: IHAVE out at
+                # ans_start, IWANT back (2 link traversals), then tx
+                up_end = jnp.maximum(
+                    up_end,
+                    jnp.where(ans_h & made_offer,
+                              ans_start_h + 2.0 * lat_edge + tx_ms[:, None],
+                              0.0).max(axis=-1))
             ihave_pp = ihave_ct.sum(axis=-1)            # (N,) IHAVEs sent
             # the IWANT flows opposite the IHAVE: the lacking RECEIVER sends
             # it, the gossiping peer receives it
             iwant_rx_pp = gossip_sent.sum(axis=-1).astype(jnp.float32)
             sends = sends + (gossip_sent & made_offer).sum(axis=-1)
-            sent_any = (made_offer & send_mask) | (gossip_sent & made_offer)
+            sent_any = eff_send | (gossip_sent & made_offer)
             arrived = sent_any if survive is None else sent_any & survive
             # ONE pull for all three involution-crossing quantities: the
             # per-edge IHAVE count (<= history_gossip), the IWANT flag and
@@ -529,18 +586,22 @@ def disseminate(
             q_gs = jnp.floor(rem / 2.0)
             ihave_rx_pp = q_ihave.sum(axis=-1)
             iwant_pp = q_gs.sum(axis=-1)
-            copies = (rem - q_gs * 2.0).sum(axis=-1)
+            arrived_rx = rem - q_gs * 2.0 > 0.5         # (N, C) copy landed
+            copies = arrived_rx.sum(axis=-1).astype(jnp.float32)
         else:
             ihave_pp = jnp.zeros((n,), jnp.float32)
             iwant_pp = jnp.zeros((n,), jnp.float32)
             ihave_rx_pp = jnp.zeros((n,), jnp.float32)
             iwant_rx_pp = jnp.zeros((n,), jnp.float32)
-            sent_any = made_offer & send_mask
+            sent_any = eff_send
             # receivers only count copies the network actually delivered
             arrived = sent_any if survive is None else sent_any & survive
-            copies = reciprocal_pull_bool(
-                arrived, conns, rev, batch_factor=fragments
-            ).sum(axis=-1)
+            arrived_rx = reciprocal_pull_bool(
+                arrived, conns, rev, batch_factor=fragments)
+            copies = arrived_rx.sum(axis=-1).astype(jnp.float32)
+        # wire-arrival time of every copy that landed at each receiver slot
+        # (for the downlink-occupancy fold below); -INF marks no-copy slots
+        arr_t = jnp.where(arrived_rx, inc, -INF)
         # slow-peer penalty (main.nim:264-299): deliveries that spent longer
         # than the threshold in the SENDER's queue mark the sender as slow
         # in the RECEIVER's score of it (the reciprocal slot) — scoring and
@@ -560,10 +621,10 @@ def disseminate(
         else:
             slow_inc = jnp.zeros((n, c), jnp.float32)
         return (sends, copies, ihave_pp, iwant_pp, ihave_rx_pp, iwant_rx_pp,
-                first_slot, slow_inc)
+                first_slot, slow_inc, arr_t, up_end)
 
     (sends_f, copies_f, ihave_f, iwant_f, ihave_rx_f, iwant_rx_f,
-     first_slot_f, slow_f) = jax.vmap(
+     first_slot_f, slow_f, arr_f, up_end_f) = jax.vmap(
         frag_accounting
     )(frag_ids, t_rx_f, rank_f, k_f, smask_f)
     sends = sends_f.sum(axis=0).astype(jnp.int32)
@@ -607,20 +668,32 @@ def disseminate(
         iwant_sent=iwant_pp,
     )
     dup = jnp.maximum(copies - fragments, 0)
-    # uplink occupancy write-back: fragment f's last send finishes
-    # (f+1)*k_f serialization slots after its start (the queue model above);
-    # the max over fragments is when the sender's uplink drains. Carried in
-    # SimState so the NEXT message's sends queue behind this one.
-    sent_f = (k_f > 0) & (t_rx_f < INF) & can_send[None, :]
-    start_f = jnp.maximum(t_rx_f + params.proc_delay_ms, uplink[None, :])
-    end_f = start_f + (frag_ids + 1.0)[:, None] * k_f * tx_ms[None, :]
-    uplink_new = jnp.maximum(
-        uplink, jnp.where(sent_f, end_f, 0.0).max(axis=0))
+    # uplink occupancy write-back: per fragment, frag_accounting computed the
+    # effective drain end — the last mesh slot actually transmitted (IDONTWANT
+    # suppression shortens trailing slots) plus answered-IWANT serializations.
+    # Carried in SimState so the NEXT message's sends queue behind this one.
+    uplink_new = jnp.maximum(uplink, up_end_f.max(axis=0))
+    # downlink occupancy write-back: fold ALL delivered copies (mesh
+    # duplicates + gossip answers, post-suppression) through each receiver's
+    # single-server downlink queue in arrival order. For ascending arrivals
+    # o_1..o_m the completion recurrence busy_j = max(o_j, busy_{j-1} + rx)
+    # unrolls to busy_m = max(rx_free + m*rx, max_j o_j + (m-j)*rx); with d_i
+    # the i-th LARGEST arrival that is max(rx_free + m*rx, max_i d_i + i*rx)
+    # — one sort plus elementwise, order-exact (tied arrivals commute).
+    arr_all = jnp.moveaxis(arr_f, 0, 1).reshape(n, fragments * c)
+    d_sorted = -jnp.sort(-arr_all, axis=-1)
+    m_copies = copies.astype(jnp.float32)
+    pos = jnp.arange(fragments * c, dtype=jnp.float32)
+    fold = jnp.where(pos[None, :] < m_copies[:, None],
+                     d_sorted + pos[None, :] * rx_ms[:, None], -INF)
+    rx_free_new = jnp.maximum(state.rx_free_ms + m_copies * rx_ms,
+                              fold.max(axis=-1))
     # the counter accrues unweighted; score() applies the (negative) weight
     slow_penalty = state.slow_penalty + slow_f.sum(axis=0)
     new_state = state.replace(
         key=key,
         uplink_free_ms=uplink_new,
+        rx_free_ms=rx_free_new,
         fmd=fmd,
         slow_penalty=slow_penalty,
         bytes_tx=state.bytes_tx + sends.astype(jnp.float32) * frag_bytes,
@@ -651,7 +724,9 @@ def disseminate(
             "g_tgt_w": g_tgt_w,         # (W, N, C) per-round gossip targets
             "survive": survive,         # (N, C) bool or None (loss)
             "hb_phase": hb_phase,       # (N,)
-            "uplink": uplink,           # (N,) pre-message occupancy
+            "uplink": uplink,           # (N,) pre-message uplink occupancy
+            "rx_free": state.rx_free_ms,  # (N,) pre-message downlink occupancy
+            "rx_ms": rx_ms,             # (N,) per-copy downlink drain time
             "can_send": can_send,       # (N,)
             "tx_ms": tx_ms,             # (N,) per-fragment uplink ms
             "lat_edge": lat_edge,       # (N, C) per-slot latency

@@ -151,6 +151,19 @@ class SimState:
     #                             max(t_rx + proc, uplink_free) and writes back
     #                             the final occupancy, coupling concurrent
     #                             messages the way shared uplinks do.
+    rx_free_ms: jnp.ndarray     # (N,) float32 ms — absolute time each peer's
+    #                             DOWNLINK drains. Shadow enforces
+    #                             host_bandwidth_down on every host
+    #                             (shadow/topogen.py:50-51): every received
+    #                             copy — wanted or duplicate — drains the
+    #                             receiver's downlink for rx_ms, so a message
+    #                             arriving while earlier traffic still drains
+    #                             completes no earlier than
+    #                             max(wire_arrival, rx_free + rx_ms).
+    #                             disseminate() applies that clamp in the
+    #                             fixpoint and writes back the exact
+    #                             single-server drain time of all copies this
+    #                             message delivered (sorted-arrival fold).
     t_ms: jnp.ndarray           # () float32 — sim clock
     key: jnp.ndarray            # jax PRNG key
     # cumulative observability counters (reference L5). GRAFT/PRUNE are
@@ -201,6 +214,7 @@ def init_state(params: SimParams, seed: int = 0) -> SimState:
         subscribed=jnp.ones((n,), dtype=bool),
         hb_phase=jax.random.uniform(k_phase, (n,)) * params.heartbeat_ms,
         uplink_free_ms=jnp.zeros((n,), dtype=jnp.float32),
+        rx_free_ms=jnp.zeros((n,), dtype=jnp.float32),
         t_ms=jnp.asarray(0.0, dtype=jnp.float32),
         key=key,
         grafts=jnp.zeros((n,), dtype=jnp.int32),

@@ -58,6 +58,10 @@ class RecvConstants:
     u_ms: jnp.ndarray       # (N, C) float32 sender uplink-free time: sends
     #                         start no earlier than this (cross-message
     #                         bandwidth contention, ops/state.py uplink_free_ms)
+    rx_c: jnp.ndarray       # (N,) float32 receiver downlink clamp: delivery
+    #                         completes no earlier than this (rx_free + rx_ms,
+    #                         ops/state.py rx_free_ms) — receiver-local, so it
+    #                         shards with the rows
     proc_ms: jnp.ndarray    # () float32
     hb_ms: jnp.ndarray      # () float32
 
@@ -82,6 +86,7 @@ def build_recv_constants(
     g_off_s: jnp.ndarray,       # (N, C) sender-side gossip-round offset (ms)
     hb_phase: jnp.ndarray,      # (N,) heartbeat phase
     uplink_free: jnp.ndarray,   # (N,) sender uplink-free time (absolute ms)
+    rx_const: jnp.ndarray,      # (N,) receiver downlink clamp (rx_free + rx_ms)
     proc_ms: float,
     hb_ms: float,
     with_gossip: bool,
@@ -117,6 +122,7 @@ def build_recv_constants(
         g_off=g_off,
         phase=phase,
         u_ms=u_ms,
+        rx_c=jnp.asarray(rx_const, jnp.float32),
         proc_ms=jnp.float32(proc_ms),
         hb_ms=jnp.float32(hb_ms),
     )
@@ -147,7 +153,11 @@ def converge_recv(
 
     def body(carry):
         t_rx, _, it = carry
-        t_new = jnp.minimum(t_rx, _inc_from(t_rx, c).min(axis=-1))
+        # downlink clamp: delivery completes no earlier than the receiver's
+        # downlink drains prior traffic plus this copy (max distributes over
+        # the row min, so clamping the min equals clamping every candidate)
+        t_new = jnp.minimum(
+            t_rx, jnp.maximum(_inc_from(t_rx, c).min(axis=-1), c.rx_c))
         return t_new, jnp.any(t_new < t_rx), it + 1
 
     t_rx, _, _ = jax.lax.while_loop(cond, body, (t0, jnp.bool_(True), 0))
@@ -162,10 +172,11 @@ def converge_sharded(
     and psums one convergence bit. Identical results to converge_recv."""
     rows = P(PEER_AXIS)
 
-    def local_fix(t0_l, src, a_ms, mesh_ok, g_ms, g_ok, g_off, phase, u_ms):
+    def local_fix(t0_l, src, a_ms, mesh_ok, g_ms, g_ok, g_off, phase, u_ms,
+                  rx_c):
         c_l = RecvConstants(
             src=src, a_ms=a_ms, mesh_ok=mesh_ok, g_ms=g_ms, g_ok=g_ok,
-            g_off=g_off, phase=phase, u_ms=u_ms,
+            g_off=g_off, phase=phase, u_ms=u_ms, rx_c=rx_c,
             proc_ms=c.proc_ms, hb_ms=c.hb_ms,
         )
 
@@ -176,7 +187,8 @@ def converge_sharded(
         def body(carry):
             t_l, _, it = carry
             t_all = jax.lax.all_gather(t_l, PEER_AXIS, tiled=True)
-            t_new = jnp.minimum(t_l, _inc_from(t_all, c_l).min(axis=-1))
+            t_new = jnp.minimum(
+                t_l, jnp.maximum(_inc_from(t_all, c_l).min(axis=-1), rx_c))
             changed = jax.lax.psum(
                 jnp.any(t_new < t_l).astype(jnp.int32), PEER_AXIS) > 0
             return t_new, changed, it + 1
@@ -187,11 +199,11 @@ def converge_sharded(
     fn = jax.shard_map(
         local_fix,
         mesh=mesh,
-        in_specs=(rows,) * 9,
+        in_specs=(rows,) * 10,
         out_specs=rows,
     )
     return fn(t0, c.src, c.a_ms, c.mesh_ok, c.g_ms, c.g_ok, c.g_off,
-              c.phase, c.u_ms)
+              c.phase, c.u_ms, c.rx_c)
 
 
 def place_sharded(mesh: Mesh, *arrays):

@@ -36,7 +36,7 @@ from ..config.env import GossipSubParams
 from ..config.topology import Topology, TopoParams
 from .simulator import ExperimentConfig, MessageRecord, Simulator
 
-FORMAT_VERSION = 3  # bump on any SimState layout change (v3: idontwant_*)
+FORMAT_VERSION = 4  # bump on any SimState layout change (v4: rx_free_ms)
 
 
 def _graph_hash(graph) -> str:

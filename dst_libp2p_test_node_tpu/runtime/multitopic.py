@@ -229,12 +229,18 @@ class MultiTopicSimulator:
         if t_ct > 1:
             u_node = self.state.uplink_free_ms.reshape(t_ct, n).max(axis=0)
             u_all = jnp.tile(u_node, t_ct)
+            # the downlink is per physical NODE too: fold receiver occupancy
+            # across topic blocks so copies of topic B drain behind topic A's
+            r_node = self.state.rx_free_ms.reshape(t_ct, n).max(axis=0)
+            r_all = jnp.tile(r_node, t_ct)
             if self.mesh is not None:
-                # keep the leaf row-sharded like the rest of the state
+                # keep the leaves row-sharded like the rest of the state
                 from ..parallel.sharding import reshard_rows
 
                 u_all = reshard_rows(u_all, self.mesh)
-            self.state = self.state.replace(uplink_free_ms=u_all)
+                r_all = reshard_rows(r_all, self.mesh)
+            self.state = self.state.replace(
+                uplink_free_ms=u_all, rx_free_ms=r_all)
         blk = slice(ti * n, (ti + 1) * n)
 
         class _Blk:  # the topic's N-row window of the stacked result

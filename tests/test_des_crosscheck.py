@@ -7,6 +7,7 @@ from-scratch event-queue simulator of the exact link model:
     send start   = max(t_rx + proc, uplink_free)
     mesh offer   = start + (rank+1 + frag*k) * tx + lat
     gossip offer = max(nextHB(t_rx + proc) + round*HB, uplink) + 3*lat + tx
+    delivery     = max(offer, rx_free[q] + rx_ms[q])   (downlink clamp)
     two phases   : re-rank with each receiver's first-delivery back-edge
                    removed from the sender's queue
 
@@ -58,6 +59,9 @@ class _Model:
         self.lat = np.asarray(plan["lat_edge"], np.float64)
         self.ph = np.asarray(plan["hb_phase"], np.float64)
         self.up = np.asarray(plan["uplink"], np.float64)
+        self.rxf = np.asarray(plan["rx_free"], np.float64)
+        self.rxm = np.asarray(plan["rx_ms"], np.float64)
+        self.rxc = self.rxf + self.rxm   # downlink clamp per receiver
         self.can = np.asarray(plan["can_send"])
         self.gw = np.asarray(plan["g_tgt_w"])
         surv = plan["survive"]
@@ -99,6 +103,10 @@ def _dijkstra(m: _Model, publisher, t_pub, send_mask, rank, k, frag):
             if q < 0:
                 continue
             cand = m.offer(p, i, tp, send_mask, rank, k, frag)
+            if cand < math.inf:
+                # delivery completes no earlier than q's downlink drains
+                # earlier traffic plus this copy
+                cand = max(cand, m.rxc[q])
             if cand < t[q]:
                 t[q] = cand
                 heapq.heappush(heap, (cand, q))
@@ -126,18 +134,22 @@ def _remove_first_sender(m: _Model, t1, publisher, send_mask, rank, k, frag):
 
 
 def des_delays(conns, rev, plan, params, publisher, t0_ms, fragments,
-               return_uplink=False):
+               return_occupancy=False, payload_bytes=15000):
     """Full DES: per fragment, two Dijkstra phases; message completes at a
-    receiver when its last fragment lands. With `return_uplink`, also
-    computes each sender's post-message uplink drain time independently
-    (fragment f's last send finishes (f+1)*k_f serialization slots after
-    its start) to cross-check the engine's occupancy write-back."""
+    receiver when its last fragment lands. With `return_occupancy`, also
+    computes each peer's post-message uplink drain time (last mesh slot
+    actually transmitted — IDONTWANT suppression shortens trailing slots —
+    plus answered-IWANT serializations) and its downlink drain time (every
+    delivered copy folded through the receiver's single-server downlink
+    queue in arrival order), independently of the engine's write-backs."""
     m = _Model(conns, rev, plan, params)
     tgt = np.asarray(plan["tgt"])
     rprio = np.asarray(plan["rprio"], np.float64)
     t_pubs = np.asarray(plan["t_pubs"], np.float64)
+    idw_on = payload_bytes >= params.idontwant_threshold_bytes
     t_frags = []
     uplink_new = m.up.copy()
+    rx_arrivals = [[] for _ in range(m.n)]   # delivered-copy wire arrivals
     for f in range(fragments):
         tgt_f = tgt.copy()
         if params.send_queue_cap < fragments and f + 1 > params.send_queue_cap:
@@ -146,28 +158,72 @@ def des_delays(conns, rev, plan, params, publisher, t0_ms, fragments,
         rank1 = _ranks(rprio, tgt_f)
         k1 = tgt_f.sum(axis=-1).astype(np.float64)
         t1 = _dijkstra(m, publisher, t_pubs[f], tgt_f, rank1, k1, f)
-        k_f = k1
+        send_f, rank_f, k_f = tgt_f, rank1, k1
         if params.exclude_first_sender:
             removed = _remove_first_sender(
                 m, t1, publisher, tgt_f, rank1, k1, f)
-            send2 = tgt_f & ~removed
-            rank2 = _ranks(rprio, send2)
-            k2 = send2.sum(axis=-1).astype(np.float64)
-            t1 = _dijkstra(m, publisher, t_pubs[f], send2, rank2, k2, f)
-            k_f = k2
-        if return_uplink:
+            send_f = tgt_f & ~removed
+            rank_f = _ranks(rprio, send_f)
+            k_f = send_f.sum(axis=-1).astype(np.float64)
+            t1 = _dijkstra(m, publisher, t_pubs[f], send_f, rank_f, k_f, f)
+        if return_occupancy:
             for p in range(m.n):
-                if k_f[p] > 0 and t1[p] < INF_CUT and m.can[p]:
-                    start = max(t1[p] + m.proc, m.up[p])
+                if not m.can[p] or t1[p] >= INF_CUT:
+                    continue
+                start = max(t1[p] + m.proc, m.up[p])
+                tick = (math.floor((t1[p] + m.proc - m.ph[p]) / m.hb) + 1.0) \
+                    * m.hb + m.ph[p]
+                last_pos = 0.0
+                for i in range(m.c):
+                    q = m.conns[p, i]
+                    if q < 0:
+                        continue
+                    # mesh send: suppressed if the target's IDONTWANT
+                    # (announced at its own delivery) lands before this
+                    # slot's transmission begins
+                    if send_f[p, i]:
+                        slot_start = start + (rank_f[p, i] + f * k_f[p]) * m.tx[p]
+                        suppressed = (idw_on and t1[q] < INF_CUT
+                                      and t1[q] + m.lat[p, i] < slot_start)
+                        if not suppressed:
+                            last_pos = max(last_pos, rank_f[p, i] + 1.0)
+                            if m.surv[p, i]:
+                                rx_arrivals[q].append(
+                                    m.offer(p, i, t1[p], send_f, rank_f,
+                                            k_f, f))
+                    # gossip rounds: an answered IWANT serializes on the
+                    # answering uplink (engine: max end over answered rounds)
+                    # and delivers one copy
+                    answered = False
+                    for h in range(m.gw.shape[0]):
+                        if not m.gw[h, p, i] or not m.surv[p, i]:
+                            continue
+                        ans_start = max(tick + h * m.hb, m.up[p])
+                        if t1[q] > ans_start + m.lat[p, i]:
+                            answered = True
+                            uplink_new[p] = max(
+                                uplink_new[p],
+                                ans_start + 2.0 * m.lat[p, i] + m.tx[p])
+                    if answered:
+                        rx_arrivals[q].append(
+                            m.offer(p, i, t1[p], send_f, rank_f, k_f, f))
+                if last_pos > 0.0:
                     uplink_new[p] = max(
-                        uplink_new[p], start + (f + 1.0) * k_f[p] * m.tx[p])
+                        uplink_new[p],
+                        start + (f * k_f[p] + last_pos) * m.tx[p])
         t_frags.append(t1)
     t_all = np.stack(t_frags)
     received = (t_all < INF_CUT).all(axis=0)
     t_rx = np.where(received, t_all.max(axis=0), math.inf)
     delays = np.where(received, t_rx - t0_ms, math.inf)
-    if return_uplink:
-        return delays, received, uplink_new
+    if return_occupancy:
+        rx_new = m.rxf.copy()
+        for q in range(m.n):
+            busy = m.rxf[q]
+            for o in sorted(rx_arrivals[q]):
+                busy = max(o, busy + m.rxm[q])
+            rx_new[q] = busy
+        return delays, received, uplink_new, rx_new
     return delays, received
 
 
@@ -186,11 +242,13 @@ def _setup(n, connect_to, seed, stages, hb_steps=8, **over):
         jnp.asarray(t.bw_up_mbit))
 
 
-def _compare(res, plan, conns, rev, params, publisher, t0, frags):
+def _compare(res, plan, conns, rev, params, publisher, t0, frags,
+             payload_bytes=15000):
     got_d = np.asarray(res.delay_ms, np.float64)
     got_r = np.asarray(res.received)
     want_d, want_r = des_delays(
-        np.asarray(conns), np.asarray(rev), plan, params, publisher, t0, frags)
+        np.asarray(conns), np.asarray(rev), plan, params, publisher, t0,
+        frags, payload_bytes=payload_bytes)
     np.testing.assert_array_equal(got_r, want_r)
     # engine runs float32 at absolute times up to ~1e4 ms: ~1e-3 ms wobble
     np.testing.assert_allclose(
@@ -242,28 +300,73 @@ def test_fixpoint_matches_des(n, ct, seed, stages, frags, loss, flood,
 
 
 @pytest.mark.parametrize("frags", [1, 3])
-def test_fixpoint_matches_des_with_uplink_carry(frags):
-    # message 1's occupancy WRITE-BACK is recomputed independently by the
-    # DES and must equal the engine's; message 2 then reads it — both sides
-    # of the cross-message coupling cross-checked, incl. multi-fragment
+def test_fixpoint_matches_des_with_occupancy_carry(frags):
+    # message 1's uplink AND downlink occupancy WRITE-BACKS are recomputed
+    # independently by the DES and must equal the engine's; message 2 then
+    # reads both — both sides of the cross-message coupling cross-checked,
+    # incl. multi-fragment
     g, params, state, a, (stage, lat, bw) = _setup(128, 8, 21, 4)
     t0 = float(state.t_ms)
     r1, s1, plan1 = disseminate(
         state, a["conns"], a["rev"], stage, lat, bw, publisher=3,
         t0_ms=t0, params=params, payload_bytes=15000, fragments=frags,
         with_gossip=True, return_plan=True)
-    _, _, want_up = des_delays(
+    _, _, want_up, want_rx = des_delays(
         np.asarray(a["conns"]), np.asarray(a["rev"]), plan1, params, 3, t0,
-        frags, return_uplink=True)
+        frags, return_occupancy=True)
     got_up = np.asarray(s1.uplink_free_ms, np.float64)
     assert float(got_up.max()) > t0
     np.testing.assert_allclose(got_up, want_up, rtol=1e-4, atol=0.5)
+    got_rx = np.asarray(s1.rx_free_ms, np.float64)
+    assert float(got_rx.max()) > t0   # every receiver drained some copies
+    np.testing.assert_allclose(got_rx, want_rx, rtol=1e-4, atol=0.5)
     res, _, plan = disseminate(
         s1, a["conns"], a["rev"], stage, lat, bw, publisher=9,
         t0_ms=t0, params=params, payload_bytes=15000, with_gossip=True,
         return_plan=True)
     assert float(np.asarray(plan["uplink"]).max()) > t0
+    assert float(np.asarray(plan["rx_free"]).max()) > t0
     _compare(res, plan, a["conns"], a["rev"], params, 9, t0, 1)
+
+
+def test_rx_contention_binds_and_moves_p99():
+    # Back-to-back publishes of large messages: the second message's
+    # deliveries queue behind the first's downlink drain. The DES must agree
+    # edge-for-edge, and the rx clamp must move the second message's tail —
+    # the effect summary_latency_large.awk:20-24 exists to measure.
+    big = 200_000   # 200 KB => rx_ms ~ 10-40 ms per copy on 40-150 Mbit hosts
+    g, params, state, a, (stage, lat, bw) = _setup(96, 7, 31, 3)
+    t0 = float(state.t_ms)
+    r1, s1, plan1 = disseminate(
+        state, a["conns"], a["rev"], stage, lat, bw, publisher=2,
+        t0_ms=t0, params=params, payload_bytes=big, with_gossip=True,
+        return_plan=True)
+    _compare(r1, plan1, a["conns"], a["rev"], params, 2, t0, 1,
+             payload_bytes=big)
+    # second message at the same t0: full contention with message 1's drain
+    r2, _, plan2 = disseminate(
+        s1, a["conns"], a["rev"], stage, lat, bw, publisher=7,
+        t0_ms=t0, params=params, payload_bytes=big, with_gossip=True,
+        return_plan=True)
+    _compare(r2, plan2, a["conns"], a["rev"], params, 7, t0, 1,
+             payload_bytes=big)
+    # same second message from the same sampled plan, but with the downlink
+    # history erased: the rx clamp must be what moved the tail
+    import jax.numpy as jnp
+
+    s1_free = s1.replace(key=s1.key, rx_free_ms=jnp.zeros_like(s1.rx_free_ms))
+    r2_free, _ = disseminate(
+        s1_free, a["conns"], a["rev"], stage, lat, bw, publisher=7,
+        t0_ms=t0, params=params, payload_bytes=big, with_gossip=True)
+    d_with = np.asarray(r2.delay_ms, np.float64)
+    d_free = np.asarray(r2_free.delay_ms, np.float64)
+    both = np.asarray(r2.received) & np.asarray(r2_free.received)
+    assert both.sum() > 60
+    p99_with = np.percentile(d_with[both], 99)
+    p99_free = np.percentile(d_free[both], 99)
+    assert (d_with[both] >= d_free[both] - 0.5).all()   # clamp only delays
+    assert p99_with > p99_free + 1.0, (
+        f"rx contention did not move p99: {p99_with} vs {p99_free}")
 
 
 def test_fixpoint_matches_des_fanout_publisher():

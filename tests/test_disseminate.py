@@ -253,7 +253,14 @@ def test_persistent_phase_controls_gossip_timing():
     np.testing.assert_array_equal(
         np.asarray(s1.hb_phase), np.asarray(state.hb_phase))
     # a later message (advanced RNG key) sees the SAME phases -> identical
-    # gossip-arrival timing, the way a real node's timer persists
+    # gossip-arrival timing, the way a real node's timer persists. Erase the
+    # occupancy carry first: message 1's answered IWANT legitimately occupies
+    # 0's uplink (and 1's downlink), which would queue message 2 behind it —
+    # this test isolates phase persistence, not bandwidth contention.
+    s1 = s1.replace(
+        uplink_free_ms=jnp.zeros_like(s1.uplink_free_ms),
+        rx_free_ms=jnp.zeros_like(s1.rx_free_ms),
+    )
     res2, _ = disseminate(s1, *args, publisher=0, t0_ms=0.0, params=params,
                           payload_bytes=15000, with_gossip=True)
     np.testing.assert_array_equal(

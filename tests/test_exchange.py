@@ -49,16 +49,20 @@ def _scenario(seed=0, with_gossip=True):
     uplink = jnp.asarray(
         (rng.uniform(0, 400, size=N) * (rng.random(N) < 0.5))
         .astype(np.float32))
+    # nonzero downlink clamp on some peers (receiver-side contention term)
+    rx_const = jnp.asarray(
+        (rng.uniform(0, 500, size=N) * (rng.random(N) < 0.5))
+        .astype(np.float32))
     consts = build_recv_constants(
         conns, rev, lat_edge, tx_ms, rank, k_p, 0.0, send_mask, can_send,
-        g_tgt, g_off, hb_phase, uplink, PROC, HB, with_gossip,
+        g_tgt, g_off, hb_phase, uplink, rx_const, PROC, HB, with_gossip,
     )
     return (graph, lat_edge, tx_ms, send_mask, rank, k_p, g_tgt, g_off,
-            hb_phase, uplink, consts)
+            hb_phase, uplink, rx_const, consts)
 
 
 def _dense_reference(graph, lat_edge, tx_ms, send_mask, rank, k_p,
-                     g_tgt, g_off, hb_phase, uplink, t0, iters=64):
+                     g_tgt, g_off, hb_phase, uplink, rx_const, t0, iters=64):
     """Host-side sender-perspective fixpoint (mirrors ops/disseminate's
     offers+pull semantics, written independently in numpy)."""
     conns = graph.conns
@@ -72,6 +76,7 @@ def _dense_reference(graph, lat_edge, tx_ms, send_mask, rank, k_p,
     gf = np.asarray(g_off)
     ph = np.asarray(hb_phase)
     up = np.asarray(uplink)
+    rxc = np.asarray(rx_const)
     for _ in range(iters):
         new = t.copy()
         for p in range(N):
@@ -82,14 +87,15 @@ def _dense_reference(graph, lat_edge, tx_ms, send_mask, rank, k_p,
             for i, q in enumerate(conns[p]):
                 if q < 0:
                     continue
+                # delivery completes no earlier than the receiver's downlink
+                # clamp (rx_free + rx_ms) — applied per candidate
                 if sm[p, i]:
                     cand = start + (rk[p, i] + 1.0) * txm[p] + lat[p, i]
-                    new[q] = min(new[q], cand)
+                    new[q] = min(new[q], max(cand, rxc[q]))
                 if gt[p, i]:
                     hb = (np.floor((base - ph[p]) / HB) + 1.0) * HB + ph[p]
-                    new[q] = min(
-                        new[q],
-                        max(hb + gf[p, i], up[p]) + 3.0 * lat[p, i] + txm[p])
+                    cand = max(hb + gf[p, i], up[p]) + 3.0 * lat[p, i] + txm[p]
+                    new[q] = min(new[q], max(cand, rxc[q]))
         if (new == t).all():
             break
         t = new
@@ -99,13 +105,13 @@ def _dense_reference(graph, lat_edge, tx_ms, send_mask, rank, k_p,
 @pytest.mark.parametrize("with_gossip", [False, True])
 def test_recv_fixpoint_matches_dense_reference(with_gossip):
     (graph, lat_edge, tx_ms, send_mask, rank, k_p, g_tgt, g_off, hb_phase,
-     uplink, consts) = _scenario(seed=1, with_gossip=with_gossip)
+     uplink, rx_const, consts) = _scenario(seed=1, with_gossip=with_gossip)
     t0 = jnp.full((N,), INF).at[0].set(123.0)
     got = np.asarray(converge_recv(t0, consts, 64), dtype=np.float64)
     t0_np = np.full(N, np.float64(np.asarray(INF)))
     t0_np[0] = 123.0
     want = _dense_reference(graph, lat_edge, tx_ms, send_mask, rank, k_p,
-                            g_tgt, g_off, hb_phase, uplink, t0_np)
+                            g_tgt, g_off, hb_phase, uplink, rx_const, t0_np)
     reached = want < 1e37
     assert reached.sum() > N // 2     # scenario actually disseminates
     np.testing.assert_allclose(got[reached], want[reached], rtol=1e-5)
