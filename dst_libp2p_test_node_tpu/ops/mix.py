@@ -89,18 +89,35 @@ def mix_route(
     params: MixParams,
     n: int,
     payload_bytes,
-) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    uplink_free_ms=None,         # (N,) or None: shared-uplink occupancy
+    rx_free_ms=None,             # (N,) or None: shared-downlink occupancy
+    t0_ms=0.0,                   # absolute origin send time (occupancy mode)
+):
     """Sample a MIXD-hop path and price it.
 
-    Returns (path, exit_node, path_delay_ms): the MIXD relay peer ids, the
+    Returns (path, exit_node, path_delay_ms) — the MIXD relay peer ids, the
     peer that will publish into GossipSub on the origin's behalf, and the
     elapsed time between the origin's send and the exit node being ready to
-    publish. Dead mix nodes (churn) are excluded from the draw; the
-    publisher never relays its own packet. Sampling MIXD distinct nodes =
-    top-MIXD of one uniform vector masked to eligible mix nodes — an
-    argsort, not a loop. Precondition (host-checked via
-    eligible_mix_count): at least mix_d eligible nodes, else the path tail
-    would silently pick up ineligible peers.
+    publish — plus, when occupancy arrays are given,
+    (uplink_free_new, rx_free_new).
+
+    Dead mix nodes (churn) are excluded from the draw; the publisher never
+    relays its own packet. Sampling MIXD distinct nodes = top-MIXD of one
+    uniform vector masked to eligible mix nodes — an argsort, not a loop.
+    Precondition (host-checked via eligible_mix_count): at least mix_d
+    eligible nodes, else the path tail would silently pick up ineligible
+    peers.
+
+    Occupancy coupling (mix and GossipSub traffic share each node's real
+    links): with `uplink_free_ms`/`rx_free_ms`, every hop's serialization
+    starts no earlier than the sender's uplink drains in-flight mesh/gossip
+    traffic (start = max(ready, uplink_free[sender])), the arriving packets
+    drain the relay's downlink behind earlier arrivals (completion =
+    max(wire, rx_free[relay] + rx_ms)), and both occupancies are written
+    back — so a mix relay's subsequent mesh forwarding queues behind the
+    Sphinx transmission it just made, and vice versa. Hops are chained
+    sequentially (the packet exists at one relay at a time), mix_d is
+    static, so the loop unrolls into straight-line XLA.
     """
     mix_ok = mix_node_mask(n, params.num_mix) & alive
     mix_ok = mix_ok & (jnp.arange(n) != publisher)
@@ -122,8 +139,28 @@ def mix_route(
     n_packets = jnp.ceil(jnp.asarray(payload_bytes, jnp.float32) / params.body_bytes)
     wire_bytes = n_packets * params.packet_bytes
     tx_ms = (wire_bytes * 8.0) / (bw_up_mbit_per_stage[stage[hops_from]] * 1e6) * 1e3
-    delay = jnp.sum(hop_lat + tx_ms) + params.mix_d * params.proc_delay_ms
-    return path, path[-1], delay.astype(jnp.float32)
+    if uplink_free_ms is None:
+        delay = jnp.sum(hop_lat + tx_ms) + params.mix_d * params.proc_delay_ms
+        return path, path[-1], delay.astype(jnp.float32)
+
+    # occupancy-coupled chain: absolute times, hop by hop
+    uplink = jnp.asarray(uplink_free_ms, jnp.float32)
+    rx_free = (jnp.zeros((n,), jnp.float32) if rx_free_ms is None
+               else jnp.asarray(rx_free_ms, jnp.float32))
+    # reference topology: bw_down == bw_up per stage (shadow/topogen.py:50-51)
+    rx_hop = (wire_bytes * 8.0) / (
+        bw_up_mbit_per_stage[stage[hops_to]] * 1e6) * 1e3
+    ready = jnp.asarray(t0_ms, jnp.float32)
+    for h in range(params.mix_d):
+        s, r = hops_from[h], hops_to[h]
+        start = jnp.maximum(ready, uplink[s])
+        uplink = uplink.at[s].set(start + tx_ms[h])
+        wire = start + tx_ms[h] + hop_lat[h]
+        done = jnp.maximum(wire, rx_free[r] + rx_hop[h])
+        rx_free = rx_free.at[r].set(done)
+        ready = done + params.proc_delay_ms   # Sphinx unwrap at the relay
+    delay = (ready - t0_ms).astype(jnp.float32)
+    return path, path[-1], delay, uplink, rx_free
 
 
 def mix_wire_bytes(params: MixParams, payload_bytes: int) -> int:

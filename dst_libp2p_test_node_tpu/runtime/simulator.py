@@ -262,7 +262,11 @@ class Simulator:
                     f"(alive, mounted, != publisher) < MIXD={self.mix_params.mix_d}"
                 )
             key, k_mix = jax.random.split(self.state.key)
-            path, exit_node, path_delay = mix_route(
+            # occupancy-coupled: each hop's Sphinx serialization queues
+            # behind the sender's in-flight mesh/gossip traffic and is
+            # written back, so a relay's NEXT mesh forwarding queues behind
+            # the mix transmission it just made (shared real links)
+            path, exit_node, path_delay, uplink_new, rx_new = mix_route(
                 k_mix,
                 publisher,
                 self.state.alive,
@@ -272,6 +276,9 @@ class Simulator:
                 params=self.mix_params,
                 n=self.params.n,
                 payload_bytes=size,
+                uplink_free_ms=self.state.uplink_free_ms,
+                rx_free_ms=self.state.rx_free_ms,
+                t0_ms=t0_ms,
             )
             mix_delay = float(path_delay)
             wire = float(mix_wire_bytes(self.mix_params, size))
@@ -284,7 +291,8 @@ class Simulator:
             bytes_tx = self.state.bytes_tx.at[senders].add(wire)
             bytes_rx = self.state.bytes_rx.at[path].add(wire)
             self.state = self.state.replace(
-                key=key, bytes_tx=bytes_tx, bytes_rx=bytes_rx
+                key=key, bytes_tx=bytes_tx, bytes_rx=bytes_rx,
+                uplink_free_ms=uplink_new, rx_free_ms=rx_new,
             )
             publisher = int(exit_node)
         res, self.state = disseminate(
