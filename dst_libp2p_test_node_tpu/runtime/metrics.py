@@ -380,17 +380,26 @@ class NodeMetrics:
         self.received_iwant.set(float(sum(iwant_rx[r] for r in rows)))
         self.broadcast_idontwant.set(float(sum(idw_tx[r] for r in rows)))
         self.received_idontwant.set(float(sum(idw_rx[r] for r in rows)))
-        # SUBSCRIBE control messages fire once per (peer, joined topic) at
-        # startup and are broadcast to every connected peer (the Go tracer
-        # counts both directions); project them from the subscription state
-        sub_np = (np.asarray(sim.subscribed_np) if multitopic
-                  # host mirror maintained by set_subscribed — no device sync
-                  else np.asarray(sim._subscribed_np)[None, :])
-        n_sub_self = int(sub_np[:, peer_id].sum())
+        # SUBSCRIBE/UNSUBSCRIBE control messages fire once per (peer, topic)
+        # state CHANGE — at startup and on every later flip — and are
+        # broadcast to every connected peer (the Go tracer counts messages
+        # cumulatively, metrics.go RecvRPC). The Simulator accumulates the
+        # events host-side in set_subscribed; the multitopic membership is
+        # fixed at boot, so its event count IS the subscription matrix.
+        if multitopic:
+            sub_ev = np.asarray(sim.subscribed_np, dtype=np.int64)
+            unsub_ev = np.zeros_like(sub_ev)
+        else:
+            sub_ev = np.asarray(sim._sub_events_np)[None, :]
+            unsub_ev = np.asarray(sim._unsub_events_np)[None, :]
         nbrs = sim.graph.conns[peer_id]
         nbrs = nbrs[nbrs >= 0]
-        self.broadcast_subscriptions.set(float(n_sub_self * len(nbrs)))
-        self.received_subscriptions.set(float(sub_np[:, nbrs].sum()))
+        self.broadcast_subscriptions.set(
+            float(int(sub_ev[:, peer_id].sum()) * len(nbrs)))
+        self.received_subscriptions.set(float(sub_ev[:, nbrs].sum()))
+        self.broadcast_unsubscriptions.set(
+            float(int(unsub_ev[:, peer_id].sum()) * len(nbrs)))
+        self.received_unsubscriptions.set(float(unsub_ev[:, nbrs].sum()))
         self.duplicates.set(float(sum(dup[r] for r in rows)))
 
     def render(self) -> str:

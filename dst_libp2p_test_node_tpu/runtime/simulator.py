@@ -34,9 +34,29 @@ from ..ops.state import SimParams, graph_arrays, init_state
 from .logemit import LatenciesWriter
 from .summarize import LatencySummary, report, summarize
 
-# steady-state per-hop processing cost by muxer (validation + framing; the
-# transports differ only in handshake/stream constants, SURVEY.md §5)
-MUXER_PROC_MS = {"yamux": 2.0, "mplex": 2.2, "quic": 1.5}
+# Steady-state per-hop processing cost by muxer, DERIVED from the transport
+# stack each choice composes (main.nim:433-441, main.go:361-366,
+# main.rs:418-440) rather than asserted:
+#
+# The reference runs verifySignature=false (main.nim:247) and Noise over TCP
+# for the muxed stacks (main.nim:425-427), so per-hop cost is NOT crypto or
+# framing bytes (both are tens of µs for 15 KB) — it is ASYNC EVENT-LOOP
+# CROSSINGS: each hop traverses the scheduler once per layer that re-queues
+# the bytes (chronos/tokio/go-runtime dispatch under Shadow's single-core
+# hosts costs ~0.5 ms per crossing under load).
+#
+#   TCP+yamux  (withTcpTransport.withYamux): kernel TCP read -> Noise
+#              decrypt loop -> yamux frame demux/window accounting ->
+#              gossipsub RPC handler            = 4 crossings -> 2.0 ms
+#   TCP+mplex  (withTcpTransport.withMplex): same 4 layers, but mplex's
+#              varint header forces a header-then-payload double read per
+#              frame (one extra partial wakeup)  ~ 4.4 crossings -> 2.2 ms
+#   QUIC       (withQuicTransport): streams and crypto are native to the
+#              transport — kernel UDP read -> QUIC packet/stream assembly
+#              -> gossipsub RPC handler          = 3 crossings -> 1.5 ms
+EVENT_LOOP_MS = 0.5          # one async-scheduler crossing under load
+_MUXER_CROSSINGS = {"yamux": 4.0, "mplex": 4.4, "quic": 3.0}
+MUXER_PROC_MS = {m: EVENT_LOOP_MS * x for m, x in _MUXER_CROSSINGS.items()}
 
 _INF_CUTOFF = 1e30
 
@@ -192,6 +212,12 @@ class Simulator:
         # path (static arg) without a device sync; keep in sync via
         # set_subscribed()
         self._subscribed_np = np.ones(n, dtype=bool)
+        # cumulative SUBSCRIBE/UNSUBSCRIBE control-message counts per peer
+        # (the Go tracer counts MESSAGES, metrics.go RecvRPC — a projection
+        # from current state would diverge under mid-run churn): every node
+        # joins at startup, every later flip broadcasts one more message
+        self._sub_events_np = np.ones(n, dtype=np.int64)
+        self._unsub_events_np = np.zeros(n, dtype=np.int64)
         self._msg_rng = np.random.default_rng(cfg.seed ^ 0x6D736749)  # msgId stream
         self._last_msg_id = -1  # go-mode monotonic timestamp tie-break
         self._hb_carry_ms = 0.0
@@ -214,6 +240,18 @@ class Simulator:
         mask = np.asarray(mask, dtype=bool)
         if mask.shape != (self.params.n,):
             raise ValueError(f"subscribed mask must be ({self.params.n},)")
+        if float(self.state.t_ms) == 0.0 and not self.records:
+            # pre-warmup: this DEFINES the startup membership — the one
+            # SUBSCRIBE each joined node broadcasts at boot, nothing for
+            # peers that never joined
+            self._sub_events_np = mask.astype(np.int64)
+            self._unsub_events_np = np.zeros_like(self._sub_events_np)
+        else:
+            # mid-run churn: every flip broadcasts one more control message
+            self._sub_events_np = (
+                self._sub_events_np + (mask & ~self._subscribed_np))
+            self._unsub_events_np = (
+                self._unsub_events_np + (~mask & self._subscribed_np))
         self._subscribed_np = mask
         sub = jnp.asarray(mask)
         if self.mesh is not None:

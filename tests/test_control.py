@@ -316,6 +316,51 @@ class TestMetricsProjection:
         assert m.low_peers_topics.get() == 0
         assert m.healthy_peers_topics.get() == 0
 
+    def test_subscription_counters_accumulate_under_churn(self):
+        # mid-run subscribe/unsubscribe flips must ADD control messages the
+        # way the Go tracer counts them cumulatively (metrics.go RecvRPC) —
+        # a projection from current state would shrink when a peer leaves
+        import numpy as np
+
+        from dst_libp2p_test_node_tpu.config.topology import TopoParams
+        from dst_libp2p_test_node_tpu.runtime.simulator import (
+            ExperimentConfig, Simulator,
+        )
+
+        cfg = ExperimentConfig(
+            topo=TopoParams(network_size=24, anchor_stages=1,
+                            msg_size_bytes=500),
+            connect_to=5, warmup_s=3.0, seed=2,
+        )
+        sim = Simulator(cfg)
+        # pre-warmup call defines startup membership: peer 7 never joins
+        boot = np.ones(24, bool)
+        boot[7] = False
+        sim.set_subscribed(boot)
+        sim.warmup()
+        # mid-run churn: peer 3 leaves, peer 7 joins, peer 3 rejoins
+        m1 = boot.copy(); m1[3] = False
+        sim.set_subscribed(m1)
+        m2 = m1.copy(); m2[7] = True
+        sim.set_subscribed(m2)
+        m3 = m2.copy(); m3[3] = True
+        sim.set_subscribed(m3)
+        ev_sub = sim._sub_events_np
+        ev_unsub = sim._unsub_events_np
+        assert ev_sub[3] == 2 and ev_unsub[3] == 1   # join, leave, rejoin
+        assert ev_sub[7] == 1 and ev_unsub[7] == 0   # only the late join
+        assert ev_sub[0] == 1                        # boot join untouched
+
+        peer = 3
+        m = NodeMetrics(peer_id=str(peer))
+        m.fill_from_sim(sim, peer)
+        nbrs = sim.graph.conns[peer]
+        nbrs = nbrs[nbrs >= 0]
+        assert m.broadcast_subscriptions.get() == 2 * len(nbrs)
+        assert m.broadcast_unsubscriptions.get() == 1 * len(nbrs)
+        assert m.received_subscriptions.get() == ev_sub[nbrs].sum()
+        assert m.received_unsubscriptions.get() == ev_unsub[nbrs].sum()
+
     def test_subscription_counters_projected(self):
         # SUBSCRIBE control messages: one per joined topic to every
         # connected peer; received = neighbors' joined-topic announcements

@@ -145,6 +145,37 @@ def test_gossip_only_dissemination():
     assert int(np.asarray(s2.ihave_tx).sum()) == int(np.asarray(s2.ihave_rx).sum())
 
 
+def test_full_mcache_window_ihave_totals_hand_computed():
+    # The reference keeps IHAVEing a message at EVERY heartbeat of the
+    # mcache gossip window (history_gossip ticks, nim-libp2p defaults via
+    # main.nim; counted per entry by metrics.go RecvRPC). Mesh coverage
+    # completes in well under one heartbeat, so nearly all of that control
+    # traffic happens AFTER dissemination is complete — the engine must
+    # still count the full window. Hand-computed expectation: every holder
+    # emits min(|candidates|, ceil(max(D_lazy, factor*|candidates|)))
+    # IHAVEs per window round, candidates = connected non-mesh topic peers.
+    g, params, state, a, (stage, lat, bw) = mesh_setup()
+    res, s2 = disseminate(
+        state, a["conns"], a["rev"], stage, lat, bw,
+        publisher=0, t0_ms=float(state.t_ms), params=params,
+        payload_bytes=15000, with_gossip=True,
+    )
+    assert bool(np.asarray(res.received).all())   # every peer is a holder
+    conns = np.asarray(a["conns"])
+    mesh = np.asarray(state.mesh_mask)
+    valid = conns >= 0                 # everyone alive & subscribed here
+    tgt = mesh & valid
+    tgt[0] = valid[0]                  # flood publisher targets all peers
+    n_cand = (valid & ~tgt).sum(axis=-1)
+    g_count = np.maximum(float(params.d_lazy), params.gossip_factor * n_cand)
+    sel = np.minimum(n_cand, np.ceil(g_count - 1e-6).astype(np.int64))
+    expected = params.history_gossip * int(sel.sum())
+    got = int(np.asarray(s2.ihave_tx).sum())
+    assert got == expected, (got, expected)
+    # and the involution conserves them
+    assert got == int(np.asarray(s2.ihave_rx).sum())
+
+
 def test_idontwant_counters():
     g, params, state, a, (stage, lat, bw) = mesh_setup()
     # large message: every RECEIVER announces IDONTWANT to its mesh members

@@ -21,6 +21,24 @@ def small_cfg(**over):
     return ExperimentConfig(**kw)
 
 
+def test_muxer_constants_derive_from_stack_crossings():
+    # the per-hop costs are EVENT_LOOP_MS x layer-crossing counts of each
+    # composed stack (main.nim:433-441), not free-floating numbers: QUIC
+    # (3 layers, muxer+crypto native) < TCP+Noise+yamux (4) < TCP+Noise+
+    # mplex (4 + double-read framing); all within the 1-3 ms band async
+    # schedulers exhibit under load
+    from dst_libp2p_test_node_tpu.runtime.simulator import (
+        EVENT_LOOP_MS, MUXER_PROC_MS, _MUXER_CROSSINGS,
+    )
+
+    assert MUXER_PROC_MS["quic"] < MUXER_PROC_MS["yamux"] < MUXER_PROC_MS["mplex"]
+    for m, v in MUXER_PROC_MS.items():
+        assert v == EVENT_LOOP_MS * _MUXER_CROSSINGS[m]
+        assert 1.0 <= v <= 3.0
+    assert _MUXER_CROSSINGS["quic"] == 3.0      # UDP -> QUIC -> pubsub
+    assert _MUXER_CROSSINGS["yamux"] == 4.0     # TCP -> Noise -> yamux -> pubsub
+
+
 def test_full_experiment_coverage_and_summary():
     sim = Simulator(small_cfg())
     recs = sim.run()
