@@ -75,7 +75,7 @@ def main() -> None:
     state = hb(state, per_burst)
     res, state = publish(state, 4)
     jax.block_until_ready(state.mesh_mask)
-    coverage = float(np.asarray(res.received).mean())
+    coverage_warmup = float(np.asarray(res.received).mean())
 
     import contextlib
     import os
@@ -85,9 +85,13 @@ def main() -> None:
             else contextlib.nullcontext())  # op-level traces on demand
     t0 = time.time()
     with prof:
+        # keep every timed message's result (device arrays — holding them
+        # adds no syncs, so dispatch overlap inside the loop is unchanged)
+        results = []
         for i in range(MESSAGES):
             state = hb(state, per_burst)
             res, state = publish(state, 4 + i)
+            results.append(res)
         jax.block_until_ready(state.mesh_mask)
     wall = time.time() - t0
     # per-phase split from a SEPARATE instrumented pass: the inner syncs it
@@ -107,8 +111,12 @@ def main() -> None:
 
     rounds = MESSAGES * per_burst
     value = N_PEERS * rounds / wall
-    delays = np.asarray(res.delay_ms)
+    # coverage and percentiles over ALL timed messages, not the last one's
+    # tail — one message at 100k peers is a noisy stand-in for the
+    # distribution across the timed publishes
+    delays = np.stack([np.asarray(r.delay_ms) for r in results])
     ok = delays < 1e30
+    coverage = float(ok.mean())
     out = {
         "metric": "simulated_peer_rounds_per_sec",
         "value": round(value, 1),
@@ -123,7 +131,9 @@ def main() -> None:
             "hb_s": round(hb_s, 3),
             "disseminate_s": round(dis_s, 3),
             "backend": jax.default_backend(),
-            "coverage": coverage,
+            "coverage": coverage,               # all timed messages
+            "coverage_warmup": coverage_warmup,
+            "timed_messages": MESSAGES,
             "p50_ms": float(np.percentile(delays[ok], 50)),
             "p99_ms": float(np.percentile(delays[ok], 99)),
         },

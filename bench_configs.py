@@ -12,12 +12,20 @@ peers*rounds/sec, coverage, p50/p99 dissemination latency (ms). Run:
   python bench_configs.py            # configs 1-4
   python bench_configs.py --all      # include the 1M mix config
   python bench_configs.py --only 3
+  python bench_configs.py --check    # gate: derived coverage expectations,
+                                     # latency sanity bands, wall-time
+                                     # regression budget vs the committed
+                                     # BENCH_CONFIGS.json; exit 1 on failure
+  python bench_configs.py --all --check --write BENCH_CONFIGS.json
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import math
+import os
+import sys
 import time
 
 import numpy as np
@@ -48,6 +56,7 @@ def _emit(config: int, n: int, wall: float, rounds: float, delays, extra=None):
     if extra:
         out.update(extra)
     print(json.dumps(out), flush=True)
+    return out
 
 
 def _topo(n, msg_size, frags=1):
@@ -102,18 +111,18 @@ def _run_simple(config, n, *, gossipsub=None, with_gossip=True, msg_size=15000,
     wall = time.time() - t0
     delays = np.concatenate([r.delays_ms for r in sim.records])
     rounds = float(sim.state.t_ms) / sim.params.heartbeat_ms
-    _emit(config, n, wall, rounds, delays)
+    return _emit(config, n, wall, rounds, delays)
 
 
 def config_1():
-    _run_simple(1, 100, msg_size=15000, warmup_s=300.0)
+    return _run_simple(1, 100, msg_size=15000, warmup_s=300.0)
 
 
 def config_2():
     from dst_libp2p_test_node_tpu.config.env import GossipSubParams
 
     gs = GossipSubParams(d=8, d_low=6, d_high=12, flood_publish=True)
-    _run_simple(2, 1000, gossipsub=gs, with_gossip=False, warmup_s=120.0)
+    return _run_simple(2, 1000, gossipsub=gs, with_gossip=False, warmup_s=120.0)
 
 
 def config_3():
@@ -147,32 +156,105 @@ def config_3():
     sim, delays = experiment()
     wall = time.time() - t0
     rounds = float(sim.state.t_ms) / sim.params.heartbeat_ms
-    _emit(3, 10_000, wall, rounds * len(cfg.topics), np.concatenate(delays),
+    return _emit(3, 10_000, wall, rounds * len(cfg.topics), np.concatenate(delays),
           extra={"topics": len(cfg.topics),
                  "health": sim.topic_health()})
 
 
 def config_4():
-    _run_simple(4, 100_000, msg_size=15000, frags=4, churn=0.001,
+    return _run_simple(4, 100_000, msg_size=15000, frags=4, churn=0.001,
                 warmup_s=60.0)
 
 
 def config_5():
-    _run_simple(5, 1_000_000, msg_size=15000, uses_mix=True, num_mix=128,
+    return _run_simple(5, 1_000_000, msg_size=15000, uses_mix=True, num_mix=128,
                 messages=2, warmup_s=30.0)
 
 
 CONFIGS = {1: config_1, 2: config_2, 3: config_3, 4: config_4, 5: config_5}
+
+ARTIFACT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_CONFIGS.json")
+
+# Regression budget vs the committed artifact: wall time may drift up to
+# this factor before the gate fails (dispatch/compile noise at small N is
+# a few hundred ms on multi-second runs).
+WALL_BUDGET = 1.20
+
+
+def expected_alive_fraction(down: float, up: float, t_hb: float) -> float:
+    """Two-state Markov churn transient: P(alive) after t_hb heartbeats from
+    all-alive, with per-heartbeat death rate `down` and revival rate `up` —
+    a(t) = a_inf + (1 - a_inf) * exp(-(down+up) t), a_inf = up/(up+down).
+    This is the DERIVED coverage expectation for the churn config: dead
+    peers cannot receive, and mesh redundancy keeps coverage of the living
+    near 1 at these rates."""
+    a_inf = up / (up + down)
+    return a_inf + (1.0 - a_inf) * math.exp(-(down + up) * t_hb)
+
+
+def check_results(results: list[dict], artifact_path: str = ARTIFACT) -> list[str]:
+    """Per-config assertions. Returns failure strings (empty = gate passes)."""
+    committed = {}
+    if os.path.exists(artifact_path):
+        with open(artifact_path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    d = json.loads(line)
+                    committed[d["config"]] = d
+    failures = []
+
+    def fail(cfg, msg):
+        failures.append(f"config {cfg}: {msg}")
+
+    for r in results:
+        c = r["config"]
+        cov, p50, p99 = r["coverage"], r["p50_ms"], r["p99_ms"]
+        # coverage floors: lossless/churn-free configs must blanket the
+        # network; the churn config must match the derived Markov transient
+        if c == 4:
+            # publish times (heartbeats): warmup 60 s + 3 messages 2 s apart
+            want = expected_alive_fraction(0.001, 0.0005, 62.0)
+            if not (want - 0.04 <= cov <= want + 0.02):
+                fail(c, f"coverage {cov} outside derived churn expectation "
+                        f"{want:.4f} (+0.02/-0.04)")
+        elif cov < 0.999:
+            fail(c, f"coverage {cov} < 0.999 on a churn-free config")
+        # latency sanity bands: delays must sit between one link latency
+        # and the mcache gossip horizon
+        if not (40.0 <= p50 <= p99):
+            fail(c, f"p50 {p50} outside [40, p99={p99}]")
+        if p99 > 20_000.0:
+            fail(c, f"p99 {p99} ms beyond any sane dissemination horizon")
+        # wall-time regression budget vs the committed artifact
+        base = committed.get(c)
+        if base and r["wall_s"] > base["wall_s"] * WALL_BUDGET:
+            fail(c, f"wall {r['wall_s']} s exceeds budget "
+                    f"{base['wall_s']} s x {WALL_BUDGET}")
+    return failures
 
 
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--all", action="store_true", help="include the 1M config")
     p.add_argument("--only", type=int, choices=sorted(CONFIGS), default=None)
+    p.add_argument("--check", action="store_true",
+                   help="apply per-config gates; exit 1 on any failure")
+    p.add_argument("--write", metavar="PATH", default=None,
+                   help="write the results as the new artifact (JSON lines)")
     a = p.parse_args()
     runs = [a.only] if a.only else ([1, 2, 3, 4, 5] if a.all else [1, 2, 3, 4])
-    for c in runs:
-        CONFIGS[c]()
+    results = [CONFIGS[c]() for c in runs]
+    failures = check_results(results) if a.check else []
+    for f in failures:
+        print(f"GATE FAIL: {f}", file=sys.stderr)
+    if a.write and not failures:
+        with open(a.write, "w") as fh:
+            for r in results:
+                fh.write(json.dumps(r) + "\n")
+    if failures:
+        sys.exit(1)
 
 
 if __name__ == "__main__":

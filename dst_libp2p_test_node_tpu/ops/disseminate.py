@@ -475,8 +475,18 @@ def disseminate(
         # row-wise one-hot via fused iota compare (scatters serialize on TPU)
         back = (jnp.arange(c) == first_slot[:, None]) & got_remote[:, None]
         send_mask = tgt_f & ~back
-        rank2 = _ranks_f32(jnp.where(send_mask, rprio, INF))
-        k2 = send_mask.sum(axis=-1).astype(jnp.float32)
+        # re-rank WITHOUT re-sorting: at most one slot left each row's send
+        # order (the back-edge, IF it was a send target at all — a first
+        # sender needn't be one of ours), so ranks after the removed slot's
+        # rank shift down by one; rows with no active removal shift nothing
+        # (r0 is +INF there). Replaces a double argsort with fused passes.
+        rm = got_remote & jnp.take_along_axis(
+            tgt_f, first_slot[:, None], axis=-1)[:, 0]
+        r0 = jnp.where(rm,
+                       jnp.take_along_axis(
+                           rank1, first_slot[:, None], axis=-1)[:, 0], INF)
+        rank2 = rank1 - (rank1 > r0[:, None])
+        k2 = k1 - rm.astype(jnp.float32)
         # phase-2 costs are pointwise <= phase-1 (a send slot was removed
         # from every queue), so t1 is a valid warm start
         t2 = converge(rank2, k2, frag_idx, t_pub, send_mask, t_init=t1)
@@ -509,11 +519,11 @@ def disseminate(
         first_slot = jnp.argmin(inc, axis=-1)
         q_t = neighbor_pull_min(  # neighbor arrival times (fragment-vmapped)
             t_rx_one, conns, rev, batch_factor=fragments)
+        start_tx = jnp.maximum(t_rx_one + params.proc_delay_ms, uplink)
         # IDONTWANT (v1.2): target announced receipt before our send began
         if payload_bytes >= params.idontwant_threshold_bytes:
-            send_start = jnp.maximum(
-                t_rx_one + params.proc_delay_ms, uplink
-            )[:, None] + (rank + frag_idx * k_p[:, None]) * tx_ms[:, None]
+            send_start = start_tx[:, None] \
+                + (rank + frag_idx * k_p[:, None]) * tx_ms[:, None]
             idw_arrived = q_t + lat_edge < send_start
             made_offer = made_offer & ~(idw_arrived & send_mask)
         eff_send = made_offer & send_mask
@@ -523,7 +533,6 @@ def disseminate(
         # fixed when an IDONTWANT suppresses an earlier send (the delivery
         # model keeps static ranks), so only trailing suppressed slots
         # shorten the drain.
-        start_tx = jnp.maximum(t_rx_one + params.proc_delay_ms, uplink)
         last_pos = jnp.max(jnp.where(eff_send, rank + 1.0, 0.0), axis=-1)
         up_end = jnp.where(
             last_pos > 0.0,
@@ -540,6 +549,7 @@ def disseminate(
             # per-round sets that are subsets of valid edges.
             ihave_ct = jnp.zeros((n, c), jnp.float32)   # per-edge IHAVEs
             gossip_sent = jnp.zeros((n, c), bool)       # edge answered an IWANT
+            best_h = jnp.zeros((n, c), jnp.float32)     # last answered round
             for h in range(n_rounds):
                 active_h = g_tgt_w[h] & havers[:, None]
                 ihave_ct = ihave_ct + active_h
@@ -555,13 +565,20 @@ def disseminate(
                     # g_deliver = g_tgt & survive delivery gating
                     ans_h = ans_h & survive
                 gossip_sent = gossip_sent | ans_h
-                # the answer serializes on the answering uplink: IHAVE out at
-                # ans_start, IWANT back (2 link traversals), then tx
-                up_end = jnp.maximum(
-                    up_end,
-                    jnp.where(ans_h & made_offer,
-                              ans_start_h + 2.0 * lat_edge + tx_ms[:, None],
-                              0.0).max(axis=-1))
+                best_h = jnp.where(ans_h, jnp.float32(h), best_h)
+            # answered IWANTs serialize on the answering uplink: IHAVE out at
+            # the tick, IWANT back (2 link traversals), then tx. The answer
+            # end grows with the round, so the drain is set by the LAST
+            # answered round (best_h) — one fused pass instead of one per
+            # round.
+            up_end = jnp.maximum(
+                up_end,
+                jnp.where(
+                    gossip_sent & made_offer,
+                    jnp.maximum(hb[:, None] + best_h * params.heartbeat_ms,
+                                uplink[:, None])
+                    + 2.0 * lat_edge + tx_ms[:, None],
+                    0.0).max(axis=-1))
             ihave_pp = ihave_ct.sum(axis=-1)            # (N,) IHAVEs sent
             # the IWANT flows opposite the IHAVE: the lacking RECEIVER sends
             # it, the gossiping peer receives it

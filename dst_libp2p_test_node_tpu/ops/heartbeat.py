@@ -60,13 +60,17 @@ def heartbeat_step(
     params: SimParams,
     batch_factor: int = 1,
     nbr_ok: jnp.ndarray | None = None,
+    valid_pre: jnp.ndarray | None = None,
 ) -> SimState:
     """`batch_factor`: width of any enclosing vmap (e.g. the topic axis of
     runtime/multitopic.py) so the pull memory dispatch sees the true
     allocation size (ops/pull.py). `nbr_ok`: optional precomputed neighbor
     alive&subscribed pull — pass it when alive/subscribed cannot change
     between steps (churn off) to hoist the pull out of a scan
-    (run_heartbeats); XLA cannot prove loop-carried state invariant itself."""
+    (run_heartbeats); XLA cannot prove loop-carried state invariant itself.
+    `valid_pre`: the fully-assembled edge validity mask, hoisting the
+    remaining per-step (N, C) conjunction too — the steady-state round is
+    then one reduce plus cond probes."""
     n, c = conns.shape
     key, k_graft, k_keep, k_churn_d, k_churn_u = jax.random.split(state.key, 5)
     t = state.t_ms
@@ -77,15 +81,20 @@ def heartbeat_step(
         dies = jax.random.uniform(k_churn_d, (n,)) < params.churn_down_per_hb
         revives = jax.random.uniform(k_churn_u, (n,)) < params.churn_up_per_hb
         alive = jnp.where(alive, ~dies, revives)
-        nbr_ok = None  # alive just changed; a precomputed pull is stale
+        nbr_ok = None   # alive just changed; precomputed masks are stale
+        valid_pre = None
 
-    has_conn = conns >= 0
-    if nbr_ok is None:
-        # one pull for the conjunction (alive AND subscribed) — each pull is
-        # a full row-gather pass, so fusing the two masks halves the cost
-        nbr_ok = neighbor_pull_bool(
-            alive & state.subscribed, conns, rev, batch_factor)
-    valid = has_conn & alive[:, None] & nbr_ok & state.subscribed[:, None]
+    if valid_pre is not None:
+        valid = valid_pre
+    else:
+        has_conn = conns >= 0
+        if nbr_ok is None:
+            # one pull for the conjunction (alive AND subscribed) — each pull
+            # is a full row-gather pass, so fusing the two masks halves the
+            # cost
+            nbr_ok = neighbor_pull_bool(
+                alive & state.subscribed, conns, rev, batch_factor)
+        valid = has_conn & alive[:, None] & nbr_ok & state.subscribed[:, None]
 
     mesh = state.mesh_mask & valid  # drop edges to dead/unsubscribed peers
     deg = mesh.sum(axis=-1)
@@ -106,6 +115,8 @@ def heartbeat_step(
     # either way (k_graft was split above).
     need = jnp.where(deg < params.d_low, params.d - deg, 0)
 
+    zeros_n = jnp.zeros((n,), jnp.int32)
+
     def do_graft(mesh):
         eligible = (valid & ~mesh & (state.backoff_until <= t)
                     & (get_scores() >= 0.0))
@@ -113,23 +124,26 @@ def heartbeat_step(
         grafted = (_ranks(g_prio) < need[:, None]) & eligible
         # GRAFT control msg: counterpart adds us to its mesh (handleGraft
         # accepts unless backed off; overflow is corrected at its own next
-        # heartbeat). The reciprocal view IS the receive side — return it
-        # so both directions can be counted per peer.
+        # heartbeat). The reciprocal view IS the receive side — both
+        # directions are counted per peer. The counter increments and the
+        # refreshed degree are reduced INSIDE the branch: at steady state
+        # the round pays no (N, C) reduce for them at all.
         graft_rx = _reciprocal_view(grafted, conns, rev, batch_factor)
         mesh = (mesh | grafted | graft_rx) & valid
-        return mesh, grafted, graft_rx
+        return (mesh, mesh.sum(axis=-1),
+                grafted.sum(axis=-1, dtype=jnp.int32),
+                graft_rx.sum(axis=-1, dtype=jnp.int32))
 
-    mesh, grafted, graft_rx = jax.lax.cond(
+    mesh, deg2, graft_tx_inc, graft_rx_inc = jax.lax.cond(
         (need > 0).any(),
         do_graft,
-        lambda m: (m, jnp.zeros_like(m), jnp.zeros_like(m)),
+        lambda m: (m, deg, zeros_n, zeros_n),
         mesh,
     )
 
     # -- PRUNE: |mesh| > D_high -> keep D (D_score best, >= D_out outbound) --
     # The whole selection (4 rank passes) plus the reciprocal pull runs under
     # a cond: at steady state no row exceeds D_high and the step skips it.
-    deg2 = mesh.sum(axis=-1)
     over = deg2 > params.d_high
 
     def do_prune(mesh):
@@ -155,13 +169,14 @@ def heartbeat_step(
         backoff = jnp.where(
             pruned | pruned_by_peer,
             t + params.prune_backoff_ms, state.backoff_until)
-        return mesh & ~pruned_by_peer, backoff, pruned, pruned_by_peer
+        return (mesh & ~pruned_by_peer, backoff,
+                pruned.sum(axis=-1, dtype=jnp.int32),
+                pruned_by_peer.sum(axis=-1, dtype=jnp.int32))
 
-    mesh, backoff, pruned, prune_rx = jax.lax.cond(
+    mesh, backoff, prune_tx_inc, prune_rx_inc = jax.lax.cond(
         over.any(),
         do_prune,
-        lambda m: (m, state.backoff_until, jnp.zeros_like(m),
-                   jnp.zeros_like(m)),
+        lambda m: (m, state.backoff_until, zeros_n, zeros_n),
         mesh,
     )
 
@@ -169,8 +184,8 @@ def heartbeat_step(
     # score sinks below the threshold, graft up to 2 peers scoring above the
     # median (escape hatch from a low-quality mesh). Static-gated: at the
     # disabled default (-10000) the sort never enters the compiled step.
-    og = jnp.zeros_like(mesh)
-    og_rx = jnp.zeros_like(mesh)
+    og_tx_inc = zeros_n
+    og_rx_inc = zeros_n
     if params.opportunistic_graft_threshold > -9999.0:
         scores = get_scores()
         deg3 = mesh.sum(axis=-1)
@@ -184,15 +199,17 @@ def heartbeat_step(
         og_prio = jnp.where(og_elig, -scores, BIG)  # best scores first
         og = (_ranks(og_prio) < 2) & og_elig
         # same steady-state economics as graft/prune: the reciprocal pull
-        # only runs when something actually grafted
+        # and the counter reduces only run when something actually grafted
         def do_og(m):
             rx = _reciprocal_view(og, conns, rev, batch_factor)
-            return (m | og | rx) & valid, rx
+            return ((m | og | rx) & valid,
+                    og.sum(axis=-1, dtype=jnp.int32),
+                    rx.sum(axis=-1, dtype=jnp.int32))
 
-        mesh, og_rx = jax.lax.cond(
+        mesh, og_tx_inc, og_rx_inc = jax.lax.cond(
             og.any(),
             do_og,
-            lambda m: (m, jnp.zeros_like(m)),
+            lambda m: (m, zeros_n, zeros_n),
             mesh,
         )
 
@@ -207,7 +224,8 @@ def heartbeat_step(
         return fmd, slow
 
     fmd, slow = jax.lax.cond(
-        (state.fmd > 0).any() | (state.slow_penalty > 0).any(),
+        # one fused (N, C) reduce for the predicate, not one per array
+        ((state.fmd > 0) | (state.slow_penalty > 0)).any(),
         do_decay,
         lambda f, s: (f, s),
         state.fmd, state.slow_penalty,
@@ -215,9 +233,11 @@ def heartbeat_step(
 
     # -- fanout expiry (v1.1 fanoutTTL): a fanout set whose owner hasn't
     # fanout-published within the TTL is dropped wholesale (nim-libp2p
-    # dropFanoutPeers). Cond-gated: runs with no fanout publishers skip it.
+    # dropFanoutPeers). Gated on the (N,) expiry stamps — nonzero only for
+    # peers that ever fanout-published — so runs with no fanout publishers
+    # pay an (N,) reduce, not an (N, C) one.
     fanout = jax.lax.cond(
-        state.fanout_mask.any(),
+        (state.fanout_expire > 0.0).any(),
         lambda fm: fm & (t < state.fanout_expire)[:, None],
         lambda fm: fm,
         state.fanout_mask,
@@ -232,12 +252,10 @@ def heartbeat_step(
         alive=alive,
         t_ms=t + params.heartbeat_ms,
         key=key,
-        grafts=state.grafts + grafted.sum(axis=-1, dtype=jnp.int32)
-        + og.sum(axis=-1, dtype=jnp.int32),
-        grafts_rx=state.grafts_rx + graft_rx.sum(axis=-1, dtype=jnp.int32)
-        + og_rx.sum(axis=-1, dtype=jnp.int32),
-        prunes=state.prunes + pruned.sum(axis=-1, dtype=jnp.int32),
-        prunes_rx=state.prunes_rx + prune_rx.sum(axis=-1, dtype=jnp.int32),
+        grafts=state.grafts + graft_tx_inc + og_tx_inc,
+        grafts_rx=state.grafts_rx + graft_rx_inc + og_rx_inc,
+        prunes=state.prunes + prune_tx_inc,
+        prunes_rx=state.prunes_rx + prune_rx_inc,
     )
 
 
@@ -257,14 +275,19 @@ def run_heartbeats(
     simulator's inter-message gaps) hit the compile cache."""
 
     nbr_ok = None
+    valid_pre = None
     if params.churn_down_per_hb == 0.0 and params.churn_up_per_hb == 0.0:
         # alive/subscribed are invariant across the scan without churn, so
-        # the neighbor pull — a full row-gather pass — hoists out of the loop
+        # the neighbor pull — a full row-gather pass — hoists out of the
+        # loop, and so does the whole edge-validity conjunction
         nbr_ok = neighbor_pull_bool(state.alive & state.subscribed, conns, rev)
+        valid_pre = ((conns >= 0) & state.alive[:, None] & nbr_ok
+                     & state.subscribed[:, None])
 
     def body(s, _):
         return heartbeat_step(
-            s, conns, rev, out_mask, params, nbr_ok=nbr_ok), None
+            s, conns, rev, out_mask, params, nbr_ok=nbr_ok,
+            valid_pre=valid_pre), None
 
     state, _ = jax.lax.scan(body, state, None, length=steps)
     return state
