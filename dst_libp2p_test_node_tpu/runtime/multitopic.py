@@ -167,6 +167,27 @@ class MultiTopicSimulator:
         self.records: list[tuple[str, MessageRecord]] = []
         self._msg_rng = np.random.default_rng(cfg.seed ^ 0x6D736749)
 
+    def reset(self) -> None:
+        """Rewind to the pre-warmup initial state, keeping the built stacked
+        graph, topology, subscription draw and compiled executables (same
+        prep/run split as Simulator.reset)."""
+        tcount = len(self.cfg.topics)
+        n = self.n_peers
+        self.state = init_state(self.params, seed=self.cfg.seed)
+        phase_node = np.asarray(self.state.hb_phase)[:n]
+        self.state = self.state.replace(
+            subscribed=jnp.asarray(self.subscribed_np.reshape(-1)),
+            hb_phase=jnp.asarray(np.tile(phase_node, tcount)))
+        if self.mesh is not None:
+            from ..parallel.sharding import place_simulation
+
+            (self.state, _, _, _, _, _) = place_simulation(
+                self.state, dict(self.arrays), self._stage, self._lat,
+                self._bw, self._loss, self.mesh)
+        self._hb_carry_ms = 0.0
+        self.records = []
+        self._msg_rng = np.random.default_rng(self.cfg.seed ^ 0x6D736749)
+
     # ---------------------------------------------------------------- stepping
 
     @property
