@@ -168,8 +168,9 @@ def config_3():
     for _ in range(3):
         t0 = time.time()
         d = experiment()
-        if time.time() - t0 < wall:
-            wall, delays = time.time() - t0, d
+        dt = time.time() - t0
+        if dt < wall:
+            wall, delays = dt, d
     rounds = float(sim.state.t_ms) / sim.params.heartbeat_ms
     return _emit(3, 10_000, wall, rounds * len(cfg.topics), np.concatenate(delays),
           extra={"topics": len(cfg.topics),

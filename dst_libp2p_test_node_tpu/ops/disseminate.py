@@ -34,6 +34,14 @@ answers included — through the queue in arrival order, exactly).
 Cross-fragment rx contention inside one message is not modeled: same-sender
 fragments are spaced k*tx >= rx_ms apart by the uplink queue, so only
 interleaved different-sender duplicates could bind, a second-order effect.
+Same-round answered-IWANT serialization is likewise approximated: a peer
+answering multiple IWANTs in one gossip round occupies its uplink for the
+MAX of the answer ends, not their sum (the reference's per-connection
+queues would serialize them). Gossip answers are rare duplicates of
+already-disseminated data (the mesh delivers first in the common case), so
+the unmodeled tail is the per-round answer count minus one extra tx each —
+the DES cross-check implements the identical max, so its agreement checks
+implementation, not this approximation.
 The whole model is differentially validated against an independent
 host-side event-queue simulator (tests/test_des_crosscheck.py).
 
@@ -570,7 +578,8 @@ def disseminate(
             # the tick, IWANT back (2 link traversals), then tx. The answer
             # end grows with the round, so the drain is set by the LAST
             # answered round (best_h) — one fused pass instead of one per
-            # round.
+            # round. Same-round answers take the MAX end, not the sum: an
+            # approximation (see module docstring) the DES mirrors exactly.
             up_end = jnp.maximum(
                 up_end,
                 jnp.where(

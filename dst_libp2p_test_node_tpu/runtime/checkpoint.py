@@ -36,7 +36,7 @@ from ..config.env import GossipSubParams
 from ..config.topology import Topology, TopoParams
 from .simulator import ExperimentConfig, MessageRecord, Simulator
 
-FORMAT_VERSION = 4  # bump on any SimState layout change (v4: rx_free_ms)
+FORMAT_VERSION = 5  # bump on any SimState layout change (v5: sub/unsub events)
 
 
 def _graph_hash(graph) -> str:
@@ -108,6 +108,11 @@ def save_checkpoint(sim: Simulator, path: str) -> None:
         json.dumps(meta).encode(), dtype=np.uint8)}
     for k, v in serialization.to_state_dict(sim.state).items():
         arrays[f"state/{k}"] = np.asarray(v)
+    # host-side counters that are NOT SimState leaves: cumulative
+    # SUBSCRIBE/UNSUBSCRIBE control-message events (a projection from
+    # current state diverges under churn — simulator.py set_subscribed)
+    arrays["host/sub_events"] = sim._sub_events_np
+    arrays["host/unsub_events"] = sim._unsub_events_np
     topo = sim.topology
     for k in _TOPO_KEYS:
         arrays[f"topo/{k}"] = np.asarray(getattr(topo, k))
@@ -158,6 +163,8 @@ def load_checkpoint(path: str, mesh=None) -> Simulator:
     sim.state = serialization.from_state_dict(sim.state, state_dict)
     # the publish-path fanout decision reads a host mirror of subscription
     sim._subscribed_np = np.asarray(sim.state.subscribed).copy()
+    sim._sub_events_np = np.asarray(z["host/sub_events"]).copy()
+    sim._unsub_events_np = np.asarray(z["host/unsub_events"]).copy()
     if mesh is not None:
         # from_state_dict replaced the constructor's sharded leaves with host
         # arrays; re-place them row-sharded (graph/topology arrays were

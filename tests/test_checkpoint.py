@@ -112,6 +112,35 @@ def test_run_resume_matches_uninterrupted(tmp_path):
         assert ra.msg_id == rb.msg_id
 
 
+def test_subscribe_event_counters_survive(tmp_path):
+    """ADVICE r3: the cumulative SUBSCRIBE/UNSUBSCRIBE event counters are
+    host-side state (a projection from current membership diverges under
+    churn) — a restore must not silently reset them to constructor
+    defaults."""
+    sim = Simulator(_cfg())
+    # startup membership: peers 0-39 join, 40-59 never do
+    mask = np.arange(60) < 40
+    sim.set_subscribed(mask)
+    sim.warmup()
+    sim.publish(4)
+    # mid-run churn before the save: 5 leave, 10 (re)join
+    flip = mask.copy()
+    flip[:5] = False
+    flip[40:50] = True
+    sim.set_subscribed(flip)
+
+    path = str(tmp_path / "subev.npz")
+    save_checkpoint(sim, path)
+    restored = load_checkpoint(path)
+
+    np.testing.assert_array_equal(restored._sub_events_np, sim._sub_events_np)
+    np.testing.assert_array_equal(
+        restored._unsub_events_np, sim._unsub_events_np)
+    # and the metrics derived from them agree (not the all-ones default)
+    assert restored._sub_events_np.sum() == 40 + 10
+    assert restored._unsub_events_np.sum() == 5
+
+
 def test_graph_mismatch_fails_loudly(tmp_path):
     # ADVICE r1: the graph is rebuilt from code on load; if graph
     # construction changed between save and load, the edge-slot state would

@@ -1,0 +1,94 @@
+"""Doc staleness tripwire (VERDICT r3 ask #9).
+
+Committed-artifact numbers quoted in README.md / PARITY.md must match the
+artifacts they quote. Doc drift survived two judging rounds because nothing
+executable pinned the prose to the data; this test greps the docs for the
+quoted numbers and fails on mismatch, so a model/benchmark change cannot
+ship without its doc lines.
+"""
+
+import glob
+import json
+import os
+import re
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _artifact():
+    rows = {}
+    with open(os.path.join(ROOT, "BENCH_CONFIGS.json")) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                d = json.loads(line)
+                rows[d["config"]] = d
+    return rows
+
+
+def _read(name):
+    with open(os.path.join(ROOT, name)) as f:
+        return f.read()
+
+
+def _fmt_k(v: float) -> str:
+    """peers*rounds/s as the README table prints it (thousands, 1 dp)."""
+    return f"{v / 1e3:.1f}k"
+
+
+def test_readme_config_table_matches_artifact():
+    rows = _artifact()
+    readme = _read("README.md")
+    # the five ladder rows: | N | <desc> | wall | rounds | cov | p50 / p99 |
+    pat = re.compile(
+        r"^\|\s*(\d)\s*\|[^|]+\|\s*([\d.]+)\s*\|\s*([\d.]+k)\s*\|"
+        r"\s*([\d.]+)\*?\s*\|\s*(\d+)\s*/\s*(\d+)\s*\|",
+        re.M,
+    )
+    found = {int(m[0]): m for m in pat.findall(readme)}
+    assert set(found) == set(rows), (
+        f"README config table rows {sorted(found)} != artifact {sorted(rows)}"
+    )
+    for c, art in rows.items():
+        cfg, wall, rps, cov, p50, p99 = found[c]
+        assert float(wall) == pytest.approx(art["wall_s"], abs=0.051), \
+            f"README config {c} wall {wall} != artifact {art['wall_s']}"
+        assert rps == _fmt_k(art["peer_rounds_per_sec"]), \
+            f"README config {c} rate {rps} != {_fmt_k(art['peer_rounds_per_sec'])}"
+        assert float(cov) == pytest.approx(art["coverage"], abs=0.0051), \
+            f"README config {c} coverage {cov} != artifact {art['coverage']}"
+        assert int(p50) == round(art["p50_ms"]), \
+            f"README config {c} p50 {p50} != artifact {art['p50_ms']}"
+        assert int(p99) == round(art["p99_ms"]), \
+            f"README config {c} p99 {p99} != artifact {art['p99_ms']}"
+
+
+def test_parity_flagship_number_matches_artifact():
+    rows = _artifact()
+    parity = _read("PARITY.md")
+    # PARITY quotes the flagship number via the canonical phrase
+    # "config-5 wall <num> s" (this exact figure was stale two rounds
+    # running); any other phrasing is itself a failure — an unanchored
+    # number is how the drift survived
+    quoted = re.findall(r"config-5 wall ([\d.]+)\s*s\b", parity)
+    assert quoted, (
+        "PARITY.md must quote the flagship number with the canonical "
+        "phrase 'config-5 wall <num> s' so this tripwire can pin it"
+    )
+    for q in quoted:
+        assert float(q) == pytest.approx(rows[5]["wall_s"], abs=0.051), (
+            f"PARITY.md quotes config-5 wall {q} s; committed artifact says "
+            f"{rows[5]['wall_s']} s — update the doc"
+        )
+
+
+def test_parity_test_file_count_matches_tree():
+    parity = _read("PARITY.md")
+    m = re.search(r"(\d+)\s+test files", parity)
+    assert m, "PARITY.md should state the test-file count"
+    actual = len(glob.glob(os.path.join(ROOT, "tests", "test_*.py")))
+    assert int(m[1]) == actual, (
+        f"PARITY.md claims {m[1]} test files; tests/ has {actual}"
+    )
