@@ -179,6 +179,11 @@ def cmd_run(argv: list[str]) -> int:
                    help="message-id layout: nim = random id embedded in the "
                    "payload (main.nim:169), go = timestamp-keyed "
                    "(go/rust nodes embed no id)")
+    p.add_argument("--loss-mode", choices=["tcp", "message"], default="tcp",
+                   help="packet-loss model for lossy topologies (-l): tcp = "
+                   "RTO retransmission latency (Shadow runs real TCP "
+                   "stacks), message = whole-copy drops (QUIC-unreliable "
+                   "style)")
     a = p.parse_args(argv)
     if (a.checkpoint or a.resume) and int(a.runs) != 1:
         # per-run states would overwrite one checkpoint file and a resume
@@ -241,6 +246,7 @@ def cmd_run(argv: list[str]) -> int:
             num_mix=a.num_mix,
             mix_d=a.mix_d,
             msgid_mode=a.msgid_mode,
+            loss_mode=a.loss_mode,
         )
         t0 = time.time()
         if a.resume:

@@ -96,6 +96,27 @@ from .state import SimParams, SimState
 
 INF = jnp.float32(3.4e38)
 
+# TCP retransmission model (loss_mode="tcp"). Under Shadow, nodes run real
+# TCP stacks over the lossy GML edges (regression/Dockerfile_amd64_shadow:
+# 3-11 — LD_PRELOAD interposition of real sockets), so per-packet loss
+# mostly becomes ADDED LATENCY, not lost coverage: the segment is
+# retransmitted after an RTO, doubling per RFC 6298 on repeat failures.
+#   RTO_edge      = max(RTO_MIN_MS, 1.5 * RTT)   (SRTT + 4*RTTVAR with
+#                   RTTVAR ~ RTT/8 at steady state; Linux clamps at
+#                   tcp_rto_min = 200 ms)
+#   retx delay(j) = sum_{k<j} RTO * 2^k = RTO * (2^j - 1)   after j failures
+#   j ~ Geometric(p): P(j >= k) = p^k, sampled once per message per
+#                   directed edge (the granularity the whole loss model
+#                   uses; per-packet re-draws are below it)
+#   j > MAX_RETRIES -> the copy is abandoned (prob p^(MAX_RETRIES+1);
+#                   at topogen-scale loss rates this is negligible, so
+#                   coverage stays ~1.0 and the loss knob moves p99 —
+#                   the Shadow-faithful behavior). Retransmitted bytes are
+#                   not re-billed to the uplink queue (second-order next
+#                   to the >= 200 ms RTO stall; documented approximation).
+RTO_MIN_MS = 200.0
+MAX_RETRIES = 6
+
 
 @struct.dataclass
 class DisseminationResult:
@@ -121,7 +142,7 @@ def _next_heartbeat(t, phase, hb_ms):
 @partial(
     jax.jit,
     static_argnames=("params", "payload_bytes", "fragments", "with_gossip",
-                     "mesh", "with_fanout", "return_plan"),
+                     "mesh", "with_fanout", "return_plan", "loss_mode"),
 )
 def disseminate(
     state: SimState,
@@ -141,6 +162,7 @@ def disseminate(
     with_fanout: bool = False,
     return_plan: bool = False,
     bw_down_mbit_per_stage=None,
+    loss_mode: str = "tcp",
 ):
     """Propagate one application message (all fragments) through the mesh.
 
@@ -155,13 +177,26 @@ def disseminate(
     expression on one device.
 
     `loss_stage`: optional (S+1, S+1) per-stage-pair packet-loss rate
-    (topogen's packet_loss edges, shadow/topogen.py:21,56). Modeled at
-    message granularity: each directed edge independently fails to carry
-    this message with its loss probability — a deliberately coarser model
-    than Shadow's per-packet loss with TCP retransmission (which mostly
-    turns loss into latency); mesh redundancy then degrades coverage
-    gracefully, which is the effect the knob exists to study. Pass None
-    (not an all-zero matrix) for the lossless fast path.
+    (topogen's packet_loss edges, shadow/topogen.py:21,56). Pass None
+    (not an all-zero matrix) for the lossless fast path. Two models,
+    selected by `loss_mode`:
+
+      "tcp" (default, Shadow-faithful): nodes under Shadow run real TCP
+      stacks over
+      the lossy edges (regression/Dockerfile_amd64_shadow:3-11), so loss
+      becomes LATENCY — the copy is redelivered after a geometric number
+      of RTO-doubling retransmissions (constants above). Coverage stays
+      ~1.0 and p99 inflates, which is what a lossy topogen `-l` run of the
+      reference measures.
+
+      "message" (QUIC-unreliable-style): each directed edge
+      independently fails to carry the whole message with its loss
+      probability; mesh redundancy then degrades coverage gracefully.
+      Kept for studying datagram-transport behavior and as the coverage
+      stressor the gossip-recovery tests use.
+
+    Either way a lost/delayed copy keeps its uplink queue slot and its
+    tx-byte accounting — the transmission happened.
 
     `return_plan`: additionally return the message's sampled "plan" — the
     send sets, rank priorities, per-round gossip targets, loss survivals,
@@ -239,14 +274,35 @@ def disseminate(
         # `survive` semantics shared with packet loss below
         gray_ok = reciprocal_pull_bool(
             sc >= params.graylist_threshold, conns, rev)
+    if loss_mode not in ("message", "tcp"):
+        raise ValueError(f"unknown loss_mode {loss_mode!r}")
+    retx_ms = None
     if loss_stage is not None:
-        # per-edge message loss (see docstring): the edge's stage-pair loss
-        # rate, sampled once per message per directed edge. `survive` gates
-        # DELIVERY only — a lost copy was still transmitted, so it keeps its
-        # uplink queue slot and its tx-byte accounting; it just never arrives
         loss_edge = jnp.where(
             sel_stage, loss_stage[stage][:, None, :], 0.0).sum(axis=-1)
-        survive = jax.random.uniform(k_loss, (n, c)) >= loss_edge
+        if loss_mode == "tcp":
+            # geometric retransmission count per directed edge (see the
+            # model constants above): P(j >= k) = p^k via the inverse-CDF
+            # j = floor(log u / log p); j > MAX_RETRIES abandons the copy
+            u = jnp.clip(jax.random.uniform(k_loss, (n, c)), 1e-12)
+            safe_p = jnp.clip(loss_edge, 1e-9, 1.0 - 1e-9)
+            j = jnp.where(
+                loss_edge > 0.0,
+                jnp.floor(jnp.log(u) / jnp.log(safe_p)),
+                0.0,
+            )
+            j = jnp.minimum(j, float(MAX_RETRIES + 1))
+            survive = j <= float(MAX_RETRIES)
+            rto = jnp.maximum(RTO_MIN_MS, 1.5 * 2.0 * lat_edge)
+            retx_ms = jnp.where(
+                survive & (j > 0.0), rto * (jnp.exp2(j) - 1.0), 0.0)
+        else:
+            # per-edge message loss (see docstring): the edge's stage-pair
+            # loss rate, sampled once per message per directed edge.
+            # `survive` gates DELIVERY only — a lost copy was still
+            # transmitted, so it keeps its uplink queue slot and its
+            # tx-byte accounting; it just never arrives
+            survive = jax.random.uniform(k_loss, (n, c)) >= loss_edge
     else:
         survive = None
     if thresholds_can_bind:
@@ -335,6 +391,12 @@ def disseminate(
     # serialize all in-flight traffic, main.nim:264-299)
     uplink = state.uplink_free_ms
 
+    # effective per-edge delivery latency: the wire latency plus (tcp loss
+    # mode) the sampled retransmission stall of the data-carrying traversal.
+    # Control messages (IHAVE/IWANT/IDONTWANT timing checks) keep the bare
+    # lat_edge — they are single small packets on their own send.
+    lat_deliver = lat_edge if retx_ms is None else lat_edge + retx_ms
+
     def offers(t_rx, rank, k_p, frag_idx, send_mask, deliver_only=False):
         """Arrival-time offers made by every peer on every neighbor slot.
         `deliver_only`: additionally mask copies the network loses — use for
@@ -345,7 +407,7 @@ def disseminate(
         # uplink serialization: (rank+1) sends of this fragment, plus the
         # frag_idx earlier fragments each occupying k_p uplink slots
         queue = (rank + 1.0 + frag_idx * k_p[:, None]) * tx_ms[:, None]
-        cand = start[:, None] + queue + lat_edge
+        cand = start[:, None] + queue + lat_deliver
         live = can_send[:, None] & (t_rx[:, None] < INF)
         sm = send_mask
         gm = g_tgt
@@ -356,7 +418,7 @@ def disseminate(
         if with_gossip:
             hb = _next_heartbeat(base, hb_phase, params.heartbeat_ms)
             g = jnp.maximum(hb[:, None] + g_off, uplink[:, None]) \
-                + 3.0 * lat_edge + tx_ms[:, None]
+                + 2.0 * lat_edge + lat_deliver + tx_ms[:, None]
             cand = jnp.minimum(cand, jnp.where(gm & live, g, INF))
         return cand
 
@@ -386,6 +448,7 @@ def disseminate(
                 conns, rev, lat_edge, tx_ms, rank, k_p, frag_idx, deliver,
                 can_send, g_deliver, g_off, hb_phase, uplink, rx_const,
                 params.proc_delay_ms, params.heartbeat_ms, with_gossip,
+                retx_ms=retx_ms,
             )
             return converge_sharded(t0, c, params.max_relax_iters, mesh)
         if exceeds_budget(jnp.float32, conns.shape, fragments):
@@ -400,6 +463,7 @@ def disseminate(
                 conns, rev, lat_edge, tx_ms, rank, k_p, frag_idx, deliver,
                 can_send, g_deliver, g_off, hb_phase, uplink, rx_const,
                 params.proc_delay_ms, params.heartbeat_ms, with_gossip,
+                retx_ms=retx_ms,
             )
             return converge_recv(t0, c, params.max_relax_iters)
         # single device below the budget: sender-major offers (loop-invariant
@@ -407,10 +471,10 @@ def disseminate(
         # per-iteration speed of a receiver-side index gather (ops/pull.py)
         queue = (rank + 1.0 + frag_idx * k_p[:, None]) * tx_ms[:, None]
         a_base = jnp.where(
-            deliver & can_send[:, None], queue + lat_edge, INF)
+            deliver & can_send[:, None], queue + lat_deliver, INF)
         g_base = jnp.where(
             g_deliver & can_send[:, None],
-            3.0 * lat_edge + tx_ms[:, None], INF)
+            2.0 * lat_edge + lat_deliver + tx_ms[:, None], INF)
 
         def cond(carry):
             _, changed, it = carry
@@ -749,6 +813,8 @@ def disseminate(
             "rprio": rprio,             # (N, C) send-order priorities
             "g_tgt_w": g_tgt_w,         # (W, N, C) per-round gossip targets
             "survive": survive,         # (N, C) bool or None (loss)
+            "retx_ms": retx_ms,         # (N, C) tcp-mode retransmit stall
+            #                             per delivered copy, or None
             "hb_phase": hb_phase,       # (N,)
             "uplink": uplink,           # (N,) pre-message uplink occupancy
             "rx_free": state.rx_free_ms,  # (N,) pre-message downlink occupancy

@@ -90,18 +90,25 @@ def build_recv_constants(
     proc_ms: float,
     hb_ms: float,
     with_gossip: bool,
+    retx_ms=None,
 ) -> RecvConstants:
     """Gather every sender-side term of ops/disseminate.offers through the
-    reverse-slot map once, leaving a fixpoint that touches only t_rx."""
+    reverse-slot map once, leaving a fixpoint that touches only t_rx.
+
+    `retx_ms`: optional (N, C) per-edge TCP-retransmission stall of the
+    data-carrying traversal (ops/disseminate loss_mode="tcp") — an additive
+    edge constant, so it folds into a_ms/g_ms here and costs the fixpoint
+    nothing per iteration."""
     valid = (conns >= 0) & (rev >= 0)
     queue = (rank + 1.0 + frag_idx * k_p[:, None]) * tx_ms[:, None]
-    a_sender = queue + lat_edge     # offers minus the send start
+    lat_deliver = lat_edge if retx_ms is None else lat_edge + retx_ms
+    a_sender = queue + lat_deliver  # offers minus the send start
     a_ms = jnp.where(valid, _edge_gather(a_sender, conns, rev), INF)
     mesh_ok = valid & _edge_gather(
         send_mask & can_send[:, None], conns, rev)
 
     if with_gossip:
-        g_sender = 3.0 * lat_edge + tx_ms[:, None]
+        g_sender = 2.0 * lat_edge + lat_deliver + tx_ms[:, None]
         g_ms = jnp.where(valid, _edge_gather(g_sender, conns, rev), INF)
         g_ok = valid & _edge_gather(g_tgt & can_send[:, None], conns, rev)
         g_off = _edge_gather(g_off_s, conns, rev)

@@ -62,10 +62,13 @@ class MultiTopicConfig:
     with_gossip: bool = True
     max_connections: int = 250       # MAXCONNECTIONS (main.nim:429)
     self_trigger: bool = True        # SELFTRIGGER (main.nim:245)
+    loss_mode: str = "tcp"           # see ExperimentConfig.loss_mode
 
     def validate(self) -> None:
         self.topo.validate()
         self.gossipsub.validate()
+        if self.loss_mode not in ("message", "tcp"):
+            raise ValueError(f"unknown loss_mode {self.loss_mode!r}")
         if not self.topics:
             raise ValueError("need at least one topic")
         if len(set(self.topics)) != len(self.topics):
@@ -239,6 +242,7 @@ class MultiTopicSimulator:
             with_gossip=self.cfg.with_gossip,
             mesh=self.mesh,
             loss_stage=self._loss,
+            loss_mode=self.cfg.loss_mode,
             with_fanout=not bool(self.subscribed_np[ti][publisher]),
         )
         # one uplink per physical NODE: fold the per-row occupancy across

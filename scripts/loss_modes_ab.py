@@ -1,0 +1,116 @@
+"""Before/after artifact for the two packet-loss models (VERDICT r3 ask #3).
+
+Runs the same seeded 1000-peer, 15 KB experiment five ways —
+
+  lossless                       (topogen -l 0.0)
+  loss 0.01 x {tcp, message}     (run.sh:33's documented rate)
+  loss 0.20 x {tcp, message}     (stress rate where the models separate)
+
+— and writes docs/LOSS_MODES.json with coverage + p50/p99 for each.
+
+Two findings the artifact certifies (asserted below so it cannot be
+committed wrong):
+
+  1. At the reference's -l 0.01 rate, BOTH models sit on the lossless
+     numbers: a receiver's delay is the min over ~D incoming copies, so a
+     1% per-edge disturbance almost never touches the winning path — mesh
+     redundancy hides low loss regardless of what loss does to a copy.
+     (The two modes share common random numbers — the same u decides
+     drop vs retransmit-count — so their agreement is edge-for-edge.)
+  2. At 20%, the models separate exactly as designed: tcp mode keeps
+     coverage ~1.0 and inflates p99 (retransmitted copies arrive >= one
+     200 ms RTO late, and with D' surviving first-try senders the tail
+     receiver population shifts); message mode shows loss as lost
+     coverage / duplicate-redundancy slack instead of a latency tail.
+
+Run:  python scripts/loss_modes_ab.py [--write docs/LOSS_MODES.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dst_libp2p_test_node_tpu.config.topology import TopoParams  # noqa: E402
+from dst_libp2p_test_node_tpu.runtime.simulator import (  # noqa: E402
+    ExperimentConfig, Simulator)
+
+LOSS = 0.01           # run.sh positional 9 / topogen -l (run.sh:33)
+STRESS = 0.20         # rate at which the two models separate measurably
+N = 1000
+MSG_SIZE = 15000
+MESSAGES = 3
+
+
+def _run(loss: float, loss_mode: str) -> dict:
+    topo = TopoParams(
+        network_size=N, anchor_stages=5, min_bandwidth=50, max_bandwidth=150,
+        min_latency=40, max_latency=130, msg_size_bytes=MSG_SIZE,
+        packet_loss=loss, messages=MESSAGES, delay_seconds=2.0,
+    )
+    cfg = ExperimentConfig(topo=topo, connect_to=10, warmup_s=60.0, seed=0,
+                           loss_mode=loss_mode)
+    sim = Simulator(cfg)
+    sim.warmup()
+    for i in range(MESSAGES):
+        if i:
+            sim.advance(2000.0)
+        sim.publish(4)
+    delays = np.concatenate([r.delays_ms for r in sim.records])
+    ok = np.isfinite(delays)
+    return {
+        "loss": loss,
+        "loss_mode": loss_mode,
+        "coverage": round(float(ok.mean()), 4),
+        "p50_ms": round(float(np.percentile(delays[ok], 50)), 1),
+        "p99_ms": round(float(np.percentile(delays[ok], 99)), 1),
+        "max_ms": round(float(delays[ok].max()), 1),
+    }
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--write", metavar="PATH", default=None)
+    a = p.parse_args()
+
+    rows = [
+        _run(0.0, "tcp"),            # lossless baseline (mode irrelevant)
+        _run(LOSS, "tcp"),
+        _run(LOSS, "message"),
+        _run(STRESS, "tcp"),
+        _run(STRESS, "message"),
+    ]
+    clean, tcp_lo, msg_lo, tcp_hi, msg_hi = rows
+    # finding 1: redundancy hides -l 0.01 in both models (within a few ms)
+    for r in (tcp_lo, msg_lo):
+        assert r["coverage"] >= 0.999, r
+        assert abs(r["p99_ms"] - clean["p99_ms"]) < 25.0, (r, clean)
+    # finding 2: at the stress rate the models separate as designed
+    assert tcp_hi["coverage"] >= 0.999, tcp_hi
+    assert tcp_hi["p99_ms"] > clean["p99_ms"] + 50.0, (tcp_hi, clean)
+    assert (msg_hi["coverage"] < tcp_hi["coverage"]
+            or msg_hi["p99_ms"] < tcp_hi["p99_ms"]), (msg_hi, tcp_hi)
+
+    out = {
+        "config": {
+            "peers": N, "msg_size_bytes": MSG_SIZE, "messages": MESSAGES,
+            "connect_to": 10, "stages": 5, "bandwidth_mbit": [50, 150],
+            "latency_ms": [40, 130], "loss_rates": [LOSS, STRESS], "seed": 0,
+        },
+        "runs": rows,
+    }
+    print(json.dumps(out, indent=2))
+    if a.write:
+        with open(a.write, "w") as f:
+            json.dump(out, f, indent=2)
+            f.write("\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -67,6 +67,11 @@ class _Model:
         surv = plan["survive"]
         self.surv = (np.ones_like(self.conns, bool) if surv is None
                      else np.asarray(surv))
+        retx = plan.get("retx_ms")
+        # tcp loss mode: per-edge retransmission stall of the data-carrying
+        # traversal (added once per delivery, not to control round trips)
+        self.retx = (np.zeros_like(self.lat) if retx is None
+                     else np.asarray(retx, np.float64))
         self.proc = params.proc_delay_ms
         self.hb = params.heartbeat_ms
         self.n, self.c = self.conns.shape
@@ -80,13 +85,16 @@ class _Model:
         if send_mask[p, i]:
             start = max(base, self.up[p])
             best = (start + (rank[p, i] + 1.0 + frag * k[p]) * self.tx[p]
-                    + self.lat[p, i])
+                    + self.lat[p, i] + self.retx[p, i])
         tick = (math.floor((base - self.ph[p]) / self.hb) + 1.0) * self.hb \
             + self.ph[p]
         for h in range(self.gw.shape[0]):
             if self.gw[h, p, i]:
+                # IHAVE out + IWANT back ride clean control packets; only
+                # the answering data send suffers the retransmission stall
                 best = min(best, max(tick + h * self.hb, self.up[p])
-                           + 3.0 * self.lat[p, i] + self.tx[p])
+                           + 3.0 * self.lat[p, i] + self.retx[p, i]
+                           + self.tx[p])
         return best
 
 
@@ -295,7 +303,41 @@ def test_fixpoint_matches_des(n, ct, seed, stages, frags, loss, flood,
     res, _, plan = disseminate(
         state, a["conns"], a["rev"], stage, lat, bw, publisher=pub,
         t0_ms=t0, params=params, payload_bytes=15000, fragments=frags,
-        with_gossip=True, loss_stage=loss_stage, return_plan=True)
+        with_gossip=True, loss_stage=loss_stage, loss_mode="message",
+        return_plan=True)
+    _compare(res, plan, a["conns"], a["rev"], params, pub, t0, frags)
+
+
+TCP_CASES = [
+    # (n, connect_to, seed, stages, fragments, loss, flood)
+    (64, 5, 40, 3, 1, 0.1, True),
+    (64, 5, 41, 2, 3, 0.3, True),
+    (128, 8, 42, 5, 1, 0.05, True),
+    (128, 8, 43, 4, 1, 0.3, False),
+    (300, 10, 44, 5, 3, 0.1, True),
+]
+
+
+@pytest.mark.parametrize("n,ct,seed,stages,frags,loss,flood", TCP_CASES)
+def test_fixpoint_matches_des_tcp_retransmit(n, ct, seed, stages, frags,
+                                             loss, flood):
+    # loss_mode="tcp": the sampled retransmission stalls (plan["retx_ms"])
+    # must reproduce through the independent event queue exactly — and at
+    # these loss rates every copy eventually lands (coverage ~1.0)
+    g, params, state, a, (stage, lat, bw) = _setup(
+        n, ct, seed, stages, flood_publish=flood)
+    loss_stage = jnp.full((stages + 1, stages + 1), loss, jnp.float32)
+    pub = seed % n
+    t0 = float(state.t_ms)
+    res, _, plan = disseminate(
+        state, a["conns"], a["rev"], stage, lat, bw, publisher=pub,
+        t0_ms=t0, params=params, payload_bytes=15000, fragments=frags,
+        with_gossip=True, loss_stage=loss_stage, loss_mode="tcp",
+        return_plan=True)
+    assert plan["retx_ms"] is not None
+    retx = np.asarray(plan["retx_ms"])
+    assert (retx > 0).any(), "no retransmission sampled at this loss rate"
+    assert np.asarray(res.received).mean() > 0.99
     _compare(res, plan, a["conns"], a["rev"], params, pub, t0, frags)
 
 

@@ -219,6 +219,7 @@ def test_multi_round_gossip_recovers_lossy_edges():
                 state, a["conns"], a["rev"], stage, lat, bw,
                 publisher=0, t0_ms=float(state.t_ms), params=params,
                 payload_bytes=15000, with_gossip=True, loss_stage=loss,
+                loss_mode="message",
             )
             tot += int(res.received.sum())
         cov[w] = tot

@@ -53,6 +53,29 @@ def test_sharded_fragments_unrolled():
 
 
 @pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 (virtual) devices")
+def test_sharded_tcp_loss_matches_single_device():
+    # loss_mode="tcp" folds the sampled retransmission stalls into the
+    # per-edge constants (parallel/exchange.py retx_ms) — the shard_map
+    # path must reproduce the single-device arrival times exactly
+    def cfg():
+        c = _cfg(packet_loss=0.3)
+        c.loss_mode = "tcp"
+        return c
+
+    a = Simulator(cfg())
+    a.warmup()
+    ra = a.publish(4)
+
+    b = Simulator(cfg(), mesh=make_peer_mesh(8))
+    b.warmup()
+    rb = b.publish(4)
+
+    assert ra.received.all()  # tcp loss never costs coverage
+    np.testing.assert_array_equal(ra.received, rb.received)
+    np.testing.assert_allclose(ra.delays_ms, rb.delays_ms, rtol=1e-5)
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 (virtual) devices")
 def test_uneven_shard_rejected():
     with pytest.raises(ValueError):
         Simulator(

@@ -25,8 +25,7 @@ def test_muxer_constants_derive_from_stack_crossings():
     # the per-hop costs are EVENT_LOOP_MS x layer-crossing counts of each
     # composed stack (main.nim:433-441), not free-floating numbers: QUIC
     # (3 layers, muxer+crypto native) < TCP+Noise+yamux (4) < TCP+Noise+
-    # mplex (4 + double-read framing); all within the 1-3 ms band async
-    # schedulers exhibit under load
+    # mplex (4 + double-read framing)
     from dst_libp2p_test_node_tpu.runtime.simulator import (
         EVENT_LOOP_MS, MUXER_PROC_MS, _MUXER_CROSSINGS,
     )
@@ -34,9 +33,28 @@ def test_muxer_constants_derive_from_stack_crossings():
     assert MUXER_PROC_MS["quic"] < MUXER_PROC_MS["yamux"] < MUXER_PROC_MS["mplex"]
     for m, v in MUXER_PROC_MS.items():
         assert v == EVENT_LOOP_MS * _MUXER_CROSSINGS[m]
-        assert 1.0 <= v <= 3.0
     assert _MUXER_CROSSINGS["quic"] == 3.0      # UDP -> QUIC -> pubsub
     assert _MUXER_CROSSINGS["yamux"] == 4.0     # TCP -> Noise -> yamux -> pubsub
+
+
+def test_event_loop_anchor_matches_committed_measurement():
+    # EVENT_LOOP_MS is MEASURED (scripts/calibrate_event_loop.py: asyncio
+    # scheduler crossing under CONNECTTO=10 sha256(15KB)-per-wake stream
+    # handler load), and the committed measurement artifact is its basis —
+    # this pins the constant to the measurement, not to an assertion
+    import json
+
+    from dst_libp2p_test_node_tpu.runtime.simulator import EVENT_LOOP_MS
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(root, "docs", "event_loop_calibration.json")) as f:
+        cal = json.load(f)
+    assert cal["payload_bytes"] == 15000 and cal["n_conns"] == 10
+    assert EVENT_LOOP_MS == pytest.approx(cal["event_loop_ms_median"], rel=0.01)
+    # and the measurement itself is stable enough to anchor on: the repeat
+    # spread stays within a factor ~2 band around the median
+    assert cal["event_loop_ms_max"] <= 2.0 * cal["event_loop_ms_median"]
+    assert cal["event_loop_ms_min"] >= 0.5 * cal["event_loop_ms_median"]
 
 
 def test_full_experiment_coverage_and_summary():
@@ -143,24 +161,56 @@ def test_churn_configured_run():
     assert alive.sum() < 100  # some churn actually happened over 30+ hb
 
 
-def test_packet_loss_degrades_coverage():
-    """topogen's -l packet loss, applied as per-edge message loss
-    (ops/disseminate.py loss_stage): heavy loss must strictly reduce
-    delivered copies vs the same seeded lossless run, and moderate loss
-    leaves coverage graceful (mesh redundancy)."""
+def _lossy_publish(loss, loss_mode, seed=3):
+    topo = TopoParams(network_size=80, anchor_stages=2, min_bandwidth=50,
+                      max_bandwidth=100, min_latency=30, max_latency=60,
+                      msg_size_bytes=500, packet_loss=loss, messages=1)
+    cfg = ExperimentConfig(topo=topo, connect_to=6, warmup_s=5.0, seed=seed,
+                           loss_mode=loss_mode)
+    sim = Simulator(cfg)
+    sim.warmup()
+    return sim.publish(4)
 
-    def run(loss):
-        topo = TopoParams(network_size=80, anchor_stages=2, min_bandwidth=50,
-                          max_bandwidth=100, min_latency=30, max_latency=60,
-                          msg_size_bytes=500, packet_loss=loss, messages=1)
-        cfg = ExperimentConfig(topo=topo, connect_to=6, warmup_s=5.0, seed=3)
-        sim = Simulator(cfg)
-        sim.warmup()
-        return sim.publish(4)
 
-    clean = run(0.0)
-    heavy = run(0.9)
+def test_packet_loss_degrades_coverage_in_message_mode():
+    """topogen's -l packet loss in loss_mode="message" (QUIC-unreliable
+    style): heavy loss must strictly reduce delivered copies vs the same
+    seeded lossless run, and moderate loss leaves coverage graceful (mesh
+    redundancy)."""
+    clean = _lossy_publish(0.0, "message")
+    heavy = _lossy_publish(0.9, "message")
     assert clean.received.mean() == 1.0
     assert heavy.received.sum() < clean.received.sum()
-    mild = run(0.05)
+    mild = _lossy_publish(0.05, "message")
     assert mild.received.mean() > 0.9  # redundancy keeps coverage graceful
+
+
+def test_packet_loss_becomes_latency_in_tcp_mode():
+    """loss_mode="tcp" (the default, Shadow-faithful): under Shadow the
+    nodes run real TCP stacks, so per-packet loss is retransmitted after an
+    RTO — coverage stays ~1.0 and the latency tail inflates instead
+    (VERDICT r3 ask #3). Compare the same seeded run across the modes."""
+    clean = _lossy_publish(0.0, "tcp")
+    tcp = _lossy_publish(0.5, "tcp")
+    msg = _lossy_publish(0.5, "message")
+
+    # tcp mode never loses coverage at any loss rate short of abandonment
+    assert tcp.received.mean() == 1.0
+    # ... it pays in latency instead: the tail inflates by RTO-scale stalls
+    p99_tcp = np.percentile(tcp.delays_ms[tcp.received], 99)
+    p99_clean = np.percentile(clean.delays_ms[clean.received], 99)
+    max_tcp = tcp.delays_ms[tcp.received].max()
+    max_clean = clean.delays_ms[clean.received].max()
+    assert p99_tcp > p99_clean + 50.0, (p99_tcp, p99_clean)
+    assert max_tcp > max_clean + 150.0, (max_tcp, max_clean)
+    # the modes are distinguishable in the physically-right direction: a
+    # TCP retransmit (>= 200 ms RTO) recovers FASTER than message mode's
+    # only fallback — waiting for next-heartbeat IHAVE/IWANT gossip — so
+    # at a rate where both lean on recovery, tcp's tail is the shorter one
+    # (message mode's coverage cliff at 0.9 is covered above)
+    p99_msg = np.percentile(msg.delays_ms[msg.received], 99)
+    assert p99_tcp < p99_msg, (p99_tcp, p99_msg)
+    # median stays in the same regime: most copies still arrive first try
+    p50_tcp = np.percentile(tcp.delays_ms[tcp.received], 50)
+    p50_clean = np.percentile(clean.delays_ms[clean.received], 50)
+    assert p50_tcp < p50_clean + 250.0
