@@ -209,6 +209,47 @@ def _teach_learners(state: KadState, flat_peers: jnp.ndarray,
     return rtable_insert(state, jnp.arange(n, dtype=jnp.int32), learn)
 
 
+def _pick_alpha(sl: jnp.ndarray, rank: jnp.ndarray, cand: jnp.ndarray,
+                s: int) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Select the ALPHA closest candidate shortlist entries by distance rank
+    and gather their ids into a dense (Q, ALPHA) block (-1 padded). Shared
+    by find_node and servicedisco.lookup so the two walks cannot diverge."""
+    pick_prio = jnp.where(cand, rank, s + 1)
+    pick = (jnp.argsort(jnp.argsort(pick_prio, axis=-1), axis=-1)
+            < ALPHA) & cand
+    p_order = jnp.argsort(~pick, axis=-1, stable=True)[:, :ALPHA]
+    p_ids = jnp.take_along_axis(jnp.where(pick, sl, -1), p_order, axis=-1)
+    return pick, p_ids
+
+
+def _merge_shortlist(keys: jnp.ndarray, sl: jnp.ndarray, queried: jnp.ndarray,
+                     pick: jnp.ndarray, resp: jnp.ndarray,
+                     targets: jnp.ndarray, s: int
+                     ) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Merge FIND_NODE responses into the shortlist: concat, dedup keeping
+    the queried copy of an id (sort key = id*2 + freshness; ids < 2^30 so
+    int32 is safe), lex-sort by XOR distance, keep the closest S with their
+    queried flags. Shared by find_node and servicedisco.lookup."""
+    q = sl.shape[0]
+    merged = jnp.concatenate([sl, resp.reshape(q, -1)], axis=-1)
+    mq = jnp.concatenate(
+        [queried | pick, jnp.zeros((q, merged.shape[1] - s), bool)], axis=-1
+    )
+    mkey = merged * 2 + jnp.where(mq, 0, 1)
+    dorder = jnp.argsort(mkey, axis=-1, stable=True)
+    msort = jnp.take_along_axis(merged, dorder, axis=-1)
+    qsort = jnp.take_along_axis(mq, dorder, axis=-1)
+    dup = jnp.concatenate(
+        [jnp.zeros((q, 1), bool), msort[:, 1:] == msort[:, :-1]], axis=-1
+    )
+    msort = jnp.where(dup | (msort < 0), -1, msort)
+    md = _dist(keys, msort, targets)
+    morder = lex_argsort(md)[:, :s]
+    sl_new = jnp.take_along_axis(msort, morder, axis=-1)
+    q_new = jnp.take_along_axis(qsort & ~dup, morder, axis=-1)
+    return sl_new, q_new
+
+
 @struct.dataclass
 class LookupResult:
     closest: jnp.ndarray     # (Q, K_RESP) int32 final shortlist heads
@@ -274,14 +315,8 @@ def find_node(
         head_unqueried = (cand & (rank < K_RESP)).any(axis=-1)
         cand = cand & head_unqueried[:, None]
         # pick the ALPHA closest unqueried, by distance rank
-        pick_prio = jnp.where(cand, rank, s + 1)
-        pick = (jnp.argsort(jnp.argsort(pick_prio, axis=-1), axis=-1)
-                < ALPHA) & cand                           # (Q, S)
+        pick, p_ids = _pick_alpha(sl, rank, cand, s)
         any_pick = pick.any(axis=-1)
-
-        # gather the ALPHA picked ids into a dense (Q, ALPHA) block
-        p_order = jnp.argsort(~pick, axis=-1, stable=True)[:, :ALPHA]
-        p_ids = jnp.take_along_axis(jnp.where(pick, sl, -1), p_order, axis=-1)
 
         resp = jax.vmap(jax.vmap(response, in_axes=(0, None)))(
             jnp.clip(p_ids, 0), targets
@@ -293,26 +328,8 @@ def find_node(
         rtt = jnp.where(p_ids >= 0, rtt, 0.0)
         round_ms = rtt.max(axis=-1)
 
-        # merge responses into the shortlist: concat, prefer queried entries
-        # on dedup (sort key = id * 2 + fresh), lex-sort by distance, keep S
-        merged = jnp.concatenate([sl, resp.reshape(q, -1)], axis=-1)
-        mq = jnp.concatenate(
-            [queried | pick, jnp.zeros((q, ALPHA * K_RESP), bool)], axis=-1
-        )
-        # dedup key: id*2 + freshness so the queried copy of an id sorts
-        # first and keeps its flag (ids < 2^30, so int32 is safe)
-        mkey = merged * 2 + jnp.where(mq, 0, 1)
-        dorder = jnp.argsort(mkey, axis=-1, stable=True)
-        msort = jnp.take_along_axis(merged, dorder, axis=-1)
-        qsort = jnp.take_along_axis(mq, dorder, axis=-1)
-        dup = jnp.concatenate(
-            [jnp.zeros((q, 1), bool), msort[:, 1:] == msort[:, :-1]], axis=-1
-        )
-        msort = jnp.where(dup | (msort < 0), -1, msort)
-        md = _dist(state.keys, msort, targets)
-        morder = lex_argsort(md)[:, :s]
-        sl_new = jnp.take_along_axis(msort, morder, axis=-1)
-        q_new = jnp.take_along_axis(qsort & ~dup, morder, axis=-1)
+        sl_new, q_new = _merge_shortlist(
+            state.keys, sl, queried, pick, resp, targets, s)
 
         improved = jnp.any(sl_new != sl, axis=-1) & any_pick
         t_acc = t_acc + jnp.where(any_pick, round_ms, 0.0)

@@ -21,14 +21,19 @@ TPU-native design on the ops/kad substrate:
                   (the safety widening), with ipSimCoefficient demoting
                   same-stage replicas (the IP-similarity spread heuristic —
                   modeled: stage is our IP-locality analog)
-  lookup wave     one find_node() per (discoverer, service), then a gather
-                  of matching unexpired records from the R closest nodes;
-                  result = advertisement count + unique provider count
-                  (core.nim:40-52's HashSet dedup)
+  lookup wave     the FULL request/response machinery per (discoverer,
+                  service): an iterative shortlist walk with ALPHA
+                  requests per wave where dead nodes cost a per-query
+                  timeout (no liveness oracle), live responders piggyback
+                  matching provider records, providers dedup ACROSS waves
+                  (core.nim:40-52's HashSet), and a lookup past its
+                  deadline fails (core.nim:36-38's valueOr branch) — see
+                  lookup() below
 
-Latency accounting: advertise/lookup cost = the underlying lookup's RTT walk
-plus one more round trip to store/fetch records. xprPublishing toggles the
-record payload size used for byte accounting (extended peer records carry
+Latency accounting: advertise cost = the underlying lookup's RTT walk plus
+one more round trip to store records; lookup cost = the walk's accumulated
+wave times including timeout stalls. xprPublishing toggles the record
+payload size used for byte accounting (extended peer records carry
 addresses; core ads only the peer id).
 """
 
@@ -66,6 +71,12 @@ class SDParams:
     ip_sim_coefficient: float = 0.0  # SD_IP_SIM_COEFF: same-stage demotion
     advert_expiry_ms: float = 900_000.0  # SD_ADVERT_EXPIRY_SECONDS default
     xpr_publishing: bool = True      # SD_XPR_PUBLISHING
+    # request machinery: a request to an unresponsive node stalls its wave
+    # by this much before the walk moves on (the discoverer has no liveness
+    # oracle); a whole lookup past the deadline fails — 30 s mirrors the
+    # kad probe's findNode(...).wait(30s) convention (kad-dht/core.nim:44)
+    query_timeout_ms: float = 5_000.0
+    lookup_deadline_ms: float = 30_000.0
 
     @property
     def replication(self) -> int:
@@ -201,12 +212,17 @@ def advertise(
 
 @struct.dataclass
 class SDLookupResult:
-    advertisements: jnp.ndarray  # (Q,) int32 records found
-    unique_peers: jnp.ndarray    # (Q,) int32 distinct providers
-    latency_ms: jnp.ndarray      # (Q,) float32
+    advertisements: jnp.ndarray  # (Q,) int32 record copies retrieved
+    unique_peers: jnp.ndarray    # (Q,) int32 distinct providers, deduped
+    #                              across ALL response waves of the lookup
+    latency_ms: jnp.ndarray      # (Q,) float32 wall time incl. timeouts
+    ok: jnp.ndarray              # (Q,) bool — False: deadline exceeded,
+    #                              counts zeroed (runLookupLoop's valueOr
+    #                              failure branch, core.nim:36-38)
+    timeouts: jnp.ndarray        # (Q,) int32 requests that timed out
 
 
-@partial(jax.jit, static_argnames=("params",))
+@partial(jax.jit, static_argnames=("params", "rounds", "shortlist"))
 def lookup(
     store: AdvertStore,
     kstate: kad.KadState,
@@ -217,38 +233,134 @@ def lookup(
     lat_ms: jnp.ndarray,
     now_ms,
     params: SDParams,
+    rounds: int = 6,
+    shortlist: int = 32,
 ) -> tuple[SDLookupResult, kad.KadState]:
-    """One lookup wave (runLookupLoop body, core.nim:30-53): walk to the
-    service key, fetch matching unexpired records from the R closest nodes,
-    count advertisements and unique providers."""
-    targets = service_keys[service_idx]
-    res, kstate = kad.find_node(kstate, discoverers, targets, stage, lat_ms)
-    replicas = res.closest[:, : params.replication]      # (Q, R)
+    """One lookup per discoverer (runLookupLoop body, core.nim:30-53), as
+    the full request/response machinery rather than an oracle walk:
 
-    rows = jnp.clip(replicas, 0)
-    prov = store.provider[rows]                          # (Q, R, A)
-    svc = store.service[rows]
-    exp = store.expires_ms[rows]
-    valid = ((replicas >= 0)[..., None] & (prov >= 0)
-             & (svc == service_idx[:, None, None]) & (exp > now_ms))
-    ads = valid.sum(axis=(-1, -2)).astype(jnp.int32)
-
-    # unique providers: flatten (R, A), sort, count first occurrences
+      - iterative waves toward the service key, ALPHA requests per wave
+        (the shortlist walk of kad.find_node);
+      - the discoverer cannot observe liveness, so a request to a dead
+        node stalls its wave by `query_timeout_ms` before the walk moves
+        on (per-query timeout; kad.find_node's oracle alive-filter is the
+        thing this machinery replaces);
+      - every live responder piggybacks its matching unexpired provider
+        records on the response (GET_PROVIDERS-style), and providers are
+        deduplicated ACROSS waves — a record fetched from three replicas
+        in three different waves is three `advertisements` but one entry
+        in `unique_peers` (core.nim:40-44's HashSet over ad.data.peerId);
+      - a lookup whose accumulated wall time exceeds
+        `lookup_deadline_ms` FAILS: counts are zeroed and `ok` is False,
+        the valueOr branch the reference logs as "Lookup failed".
+    """
+    n = kstate.rtable.shape[0]
     q = discoverers.shape[0]
-    flat = jnp.where(valid, prov, jnp.int32(2**30)).reshape(q, -1)
-    srt = jnp.sort(flat, axis=-1)
-    first = jnp.concatenate(
-        [jnp.ones((q, 1), bool), srt[:, 1:] != srt[:, :-1]], axis=-1
-    )
-    uniq = (first & (srt < 2**30)).sum(axis=-1).astype(jnp.int32)
+    s = shortlist
+    targets = service_keys[service_idx]
+    o_stage = stage[discoverers]
 
-    rep_lat = 2.0 * lat_ms[stage[discoverers][:, None],
-                           stage[jnp.clip(replicas, 0)]]
-    rep_lat = jnp.where(replicas >= 0, rep_lat, 0.0)
+    def response(peer, target_key):
+        resp = kad._closest_from_table(
+            kstate.rtable[peer], kstate.keys, target_key, kad.K_RESP)
+        return jnp.where(kstate.alive[peer], resp, -1)
+
+    sl0 = jax.vmap(
+        lambda o, t: kad._closest_from_table(
+            kstate.rtable[o], kstate.keys, t, s)
+    )(discoverers, targets)
+
+    def round_body(carry, _):
+        sl, queried, t_acc, nq, nto, ads, pmask = carry
+        d = kad._dist(kstate.keys, sl, targets)
+        order = kad.lex_argsort(d)
+        rank = jnp.argsort(order, axis=-1)
+        # request/response semantics: NO alive filter here — the
+        # discoverer finds out a peer is dead by timing out on it
+        cand = (sl >= 0) & ~queried & (sl != discoverers[:, None])
+        head_unqueried = (cand & (rank < kad.K_RESP)).any(axis=-1)
+        cand = cand & head_unqueried[:, None]
+        pick, p_ids = kad._pick_alpha(sl, rank, cand, s)
+        any_pick = pick.any(axis=-1)
+        p_live = (p_ids >= 0) & kstate.alive[jnp.clip(p_ids, 0)]
+
+        resp = jax.vmap(jax.vmap(response, in_axes=(0, None)))(
+            jnp.clip(p_ids, 0), targets
+        )                                                 # (Q, ALPHA, K_RESP)
+        resp = jnp.where((p_ids >= 0)[..., None], resp, -1)
+
+        # per-request cost: RTT for live responders, the request timeout
+        # for dead ones; the wave waits for its slowest outstanding request
+        rtt = (2.0 * lat_ms[o_stage[:, None], stage[jnp.clip(p_ids, 0)]]
+               + kad.PROC_MS)
+        cost = jnp.where(p_live, rtt, params.query_timeout_ms)
+        cost = jnp.where(p_ids >= 0, cost, 0.0)
+        round_ms = cost.max(axis=-1)
+
+        # GET_PROVIDERS piggyback: live responders return their matching
+        # unexpired records; the (Q, N) mask dedups providers across waves
+        rows = jnp.clip(p_ids, 0)
+        rprov = store.provider[rows]                      # (Q, ALPHA, A)
+        rvalid = (p_live[..., None] & (rprov >= 0)
+                  & (store.service[rows] == service_idx[:, None, None])
+                  & (store.expires_ms[rows] > now_ms))
+        ads = ads + rvalid.sum(axis=(-1, -2)).astype(jnp.int32)
+        flat_p = jnp.where(rvalid, rprov, n).reshape(q, -1)
+        pmask = jax.vmap(
+            lambda m, ids: m.at[ids].set(True, mode="drop")
+        )(pmask, flat_p)
+
+        # shortlist merge — the same helper find_node's round uses
+        sl_new, q_new = kad._merge_shortlist(
+            kstate.keys, sl, queried, pick, resp, targets, s)
+
+        t_acc = t_acc + jnp.where(any_pick, round_ms, 0.0)
+        nq = nq + (p_ids >= 0).sum(axis=-1)
+        nto = nto + ((p_ids >= 0) & ~p_live).sum(axis=-1)
+        return (sl_new, q_new, t_acc, nq, nto, ads, pmask), p_ids
+
+    zeros_i = jnp.zeros((q,), jnp.int32)
+    (sl, _, t_acc, nq, nto, ads, pmask), picked_seq = jax.lax.scan(
+        round_body,
+        (sl0, jnp.zeros((q, s), bool), jnp.zeros((q,), jnp.float32),
+         zeros_i, zeros_i, zeros_i, jnp.zeros((q, n), bool)),
+        None,
+        length=rounds,
+    )
+    picked_seq = jnp.moveaxis(picked_seq, 0, 1).reshape(q, -1)
+
+    # deadline: a lookup that ran past the budget FAILED — it reports
+    # nothing (valueOr -> continue), though the network traffic happened.
+    # STRICT comparison: the worst all-timeout walk costs exactly
+    # rounds * query_timeout_ms = the default deadline, and that walk
+    # (every wave stalled by dead nodes) must fail, not squeak through
+    ok = t_acc < params.lookup_deadline_ms
+    uniq = pmask.sum(axis=-1).astype(jnp.int32)
+    ads = jnp.where(ok, ads, 0)
+    uniq = jnp.where(ok, uniq, 0)
+
+    # learning + accounting (as kad.find_node): the origin learns its final
+    # shortlist; LIVE queried peers learn the origin; counters advance
+    kstate = kad.rtable_insert(kstate, discoverers, sl)
+    flat_peers = picked_seq.reshape(-1)
+    flat_origin = jnp.broadcast_to(
+        discoverers[:, None], picked_seq.shape).reshape(-1)
+    live_ok = kstate.alive[jnp.clip(flat_peers, 0)]
+    kstate = kad._teach_learners(kstate, flat_peers, flat_origin, live_ok)
+    served = jnp.zeros((n,), jnp.int32).at[
+        jnp.where((flat_peers >= 0) & live_ok, flat_peers, n)
+    ].add(1, mode="drop")
+    kstate = kstate.replace(
+        queries_tx=kstate.queries_tx.at[discoverers].add(nq),
+        queries_rx=kstate.queries_rx + served,
+    )
+
     out = SDLookupResult(
         advertisements=ads,
         unique_peers=uniq,
-        latency_ms=res.latency_ms + rep_lat.max(axis=-1),
+        latency_ms=t_acc,
+        ok=ok,
+        timeouts=nto,
     )
     return out, kstate
 

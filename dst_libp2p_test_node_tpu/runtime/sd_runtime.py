@@ -82,6 +82,7 @@ class SDConfig:
 @dataclass
 class SDSummary:
     lookups: int
+    lookups_failed: int
     lookups_nonempty: int
     ads_mean: float
     unique_peers_mean: float
@@ -94,7 +95,8 @@ class SDSummary:
     def report(self) -> str:
         return "\n".join([
             "Service-discovery summary",
-            f"Lookups: {self.lookups} ({self.lookups_nonempty} found >=1 ad)",
+            f"Lookups: {self.lookups} ({self.lookups_nonempty} found >=1 ad, "
+            f"{self.lookups_failed} failed)",
             f"Advertisements per lookup: mean {self.ads_mean:.1f}",
             f"Unique providers per lookup: mean {self.unique_peers_mean:.1f} "
             f"max {self.unique_peers_max} "
@@ -152,6 +154,7 @@ class SDSimulator:
         self.lines: list[str] = []
         self.lookup_records: list[tuple[int, int, int, float]] = []
         self.adv_latencies: list[float] = []
+        self.lookups_failed = 0
 
     def _log(self, line: str) -> None:
         self.lines.append(line)
@@ -203,7 +206,17 @@ class SDSimulator:
             ads = np.asarray(res.advertisements)
             uniq = np.asarray(res.unique_peers)
             lat = np.asarray(res.latency_ms)
+            ok = np.asarray(res.ok)
             for i in range(len(ads)):
+                if not ok[i]:
+                    # runLookupLoop's valueOr branch (core.nim:36-38):
+                    # warn and continue to the next service
+                    self._log(
+                        f"Lookup failed service={sid} error=deadline "
+                        f"exceeded"
+                    )
+                    self.lookups_failed += 1
+                    continue
                 self._log(
                     f"Lookup completed service={sid} "
                     f"advertisements={ads[i]} uniquePeers={uniq[i]}"
@@ -241,7 +254,8 @@ class SDSimulator:
         alat = np.array(self.adv_latencies) if self.adv_latencies \
             else np.zeros(1)
         return SDSummary(
-            lookups=len(recs),
+            lookups=len(recs) + self.lookups_failed,
+            lookups_failed=self.lookups_failed,
             lookups_nonempty=int((ads > 0).sum()),
             ads_mean=float(ads.mean()),
             unique_peers_mean=float(uniq.mean()),

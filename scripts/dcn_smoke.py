@@ -15,7 +15,12 @@ Each process:
   3. builds the 1-D peer mesh over all global devices (make_peer_mesh),
   4. runs a shard_map psum over the mesh and checks the result — a real
      cross-process collective, the primitive every fixpoint iteration of
-     the sharded engine rides on.
+     the sharded engine rides on,
+  5. runs the REAL fixpoint across the boundary: one full simulation step
+     (heartbeat + disseminate(mesh=…) -> converge_sharded) on the global
+     mesh, asserting each process's addressable rows equal the
+     single-process run at rtol 1e-5 — the cross-process mirror of
+     __graft_entry__.dryrun_multichip's equality oracle.
 
 Run:  python scripts/dcn_smoke.py            (spawns both workers, checks both)
       python scripts/dcn_smoke.py --worker I (internal: one group member)
@@ -45,6 +50,13 @@ def worker(process_id: int) -> None:
     os.environ["JAX_CPU_COLLECTIVES_IMPLEMENTATION"] = "gloo"
 
     import jax
+
+    # env-var platform selection is overridden by this environment's axon
+    # sitecustomize (the round-1 lesson recorded in
+    # __graft_entry__.dryrun_multichip); the config pin is the only one
+    # that takes precedence, and it must land before the first backend use
+    jax.config.update("jax_platforms", "cpu")
+
     import jax.numpy as jnp
     import numpy as np
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -82,8 +94,47 @@ def worker(process_id: int) -> None:
     expect = float(np.arange(n).sum())
     got = float(np.asarray(out.addressable_shards[0].data)[0])
     assert got == expect, (got, expect)
-    print(f"worker {process_id}: global_devices={n_global} psum={got} OK",
-          flush=True)
+
+    # ---- the REAL fixpoint across the process boundary ------------------
+    # One full simulation step (heartbeat + disseminate -> converge_sharded)
+    # over the global mesh; each process checks its own rows against the
+    # single-process run — same seed, same computation, no mesh.
+    from __graft_entry__ import _build, _step_fn
+    from dst_libp2p_test_node_tpu.parallel.sharding import shard_simulation
+
+    n_peers = 64
+    params, state, arrays, topo = _build(n_peers)
+    ref_delays, _ = jax.jit(_step_fn(params))(
+        state, arrays["conns"], arrays["rev"], arrays["out_mask"],
+        topo["stage"], topo["lat_ms"], topo["bw"],
+    )
+    ref = np.asarray(ref_delays)                     # local, addressable
+    ref_recv = np.isfinite(ref) & (ref < 1e30)
+    assert ref_recv.sum() > n_peers * 0.9
+
+    state_s, arrays_s, topo_s = shard_simulation(state, arrays, topo, mesh)
+    delays, _ = jax.jit(_step_fn(params, mesh=mesh))(
+        state_s, arrays_s["conns"], arrays_s["rev"], arrays_s["out_mask"],
+        topo_s["stage"], topo_s["lat_ms"], topo_s["bw"],
+    )
+    delays.block_until_ready()
+    checked = 0
+    for shard in delays.addressable_shards:
+        got_rows = np.asarray(shard.data)
+        want_rows = ref[shard.index[0]]
+        recv = np.isfinite(want_rows) & (want_rows < 1e30)
+        got_recv = np.isfinite(got_rows) & (got_rows < 1e30)
+        np.testing.assert_array_equal(got_recv, recv)
+        np.testing.assert_allclose(
+            got_rows[recv], want_rows[recv], rtol=1e-5)
+        checked += got_rows.shape[0]
+    assert checked == n_peers // NUM_PROCS, checked
+
+    print(
+        f"worker {process_id}: global_devices={n_global} psum={got} "
+        f"fixpoint rows={checked} sharded==single-process OK",
+        flush=True,
+    )
 
 
 def main() -> int:

@@ -156,6 +156,103 @@ def test_ip_sim_coefficient_spreads_replicas_across_stages():
     assert (np.asarray(stage)[holders] == 1).all()
 
 
+def _advertised_store(n, advertisers, seed=0, k_store=4):
+    """Fully-informed DHT with `advertisers` advertising svc-a."""
+    st, stage, lat = _fully_informed(n, seed=seed)
+    store = init_advert_store(n)
+    svc_keys = jnp.asarray(np.stack([service_key("svc-a")]))
+    advs = jnp.asarray(advertisers, jnp.int32)
+    params = SDParams(k_store=k_store)
+    store, st, _ = advertise(
+        store, st, advs, jnp.zeros((len(advertisers),), jnp.int32), svc_keys,
+        jnp.zeros((len(advertisers),), jnp.int32), stage, lat,
+        jnp.float32(0.0), params,
+    )
+    return store, st, stage, lat, svc_keys, params
+
+
+def test_unique_providers_monotone_in_advertiser_set():
+    # PROPERTY (VERDICT r4 ask #8): for advertiser sets A subset of B, a
+    # lookup's unique-provider count under B is >= under A, and never
+    # exceeds |B| — dedup across waves cannot double-count, and more
+    # advertisers can only be found, not lost
+    n = 64
+    rng = np.random.default_rng(7)
+    pool = rng.choice(np.arange(10, n), size=12, replace=False).tolist()
+    dis = jnp.asarray([5], jnp.int32)
+    dsvc = jnp.zeros((1,), jnp.int32)
+    prev = 0
+    for size in (3, 6, 9, 12):
+        subset = pool[:size]
+        store, st, stage, lat, svc_keys, params = _advertised_store(n, subset)
+        res, _ = lookup(store, st, dis, dsvc, svc_keys, stage, lat,
+                        jnp.float32(1000.0), params)
+        uniq = int(res.unique_peers[0])
+        assert prev <= uniq <= size, (prev, uniq, size)
+        assert int(res.advertisements[0]) >= uniq
+        assert bool(res.ok[0])
+        prev = uniq
+    assert prev == 12   # the full pool is discoverable on an informed DHT
+
+
+def test_lookup_dedups_across_waves():
+    # the same provider's records sit on k_store replicas contacted over
+    # several waves: advertisements counts every retrieved copy, but
+    # unique_peers counts the provider ONCE (core.nim:40-44's HashSet)
+    n = 64
+    store, st, stage, lat, svc_keys, params = _advertised_store(
+        n, [7], k_store=8)
+    res, _ = lookup(store, st, jnp.asarray([3], jnp.int32),
+                    jnp.zeros((1,), jnp.int32), svc_keys, stage, lat,
+                    jnp.float32(1000.0), params)
+    assert int(res.advertisements[0]) > 1    # several replica copies seen
+    assert int(res.unique_peers[0]) == 1     # one provider
+
+
+def test_dead_nodes_cost_query_timeouts():
+    # request/response semantics: the discoverer has no liveness oracle, so
+    # a dead shortlist node stalls its wave by query_timeout_ms — latency
+    # grows by at least one timeout vs the all-alive walk, and the lookup
+    # still completes through the surviving replicas
+    n = 64
+    store, st, stage, lat, svc_keys, params = _advertised_store(
+        n, [7, 8, 9], k_store=8)
+    dis = jnp.asarray([3], jnp.int32)
+    dsvc = jnp.zeros((1,), jnp.int32)
+    res_live, _ = lookup(store, st, dis, dsvc, svc_keys, stage, lat,
+                         jnp.float32(1000.0), params)
+    assert int(res_live.timeouts[0]) == 0
+
+    # kill a third of the network (none of the advertisers/discoverer)
+    alive = np.ones(n, bool)
+    dead = [i for i in range(10, n) if i % 3 == 0][:16]
+    alive[dead] = False
+    st_dead = st.replace(alive=jnp.asarray(alive))
+    res_dead, _ = lookup(store, st_dead, dis, dsvc, svc_keys, stage, lat,
+                         jnp.float32(1000.0), params)
+    assert int(res_dead.timeouts[0]) >= 1
+    assert float(res_dead.latency_ms[0]) >= (
+        float(res_live.latency_ms[0]) + params.query_timeout_ms - 1.0)
+    assert bool(res_dead.ok[0])
+    assert int(res_dead.unique_peers[0]) >= 1   # survivors still answer
+
+
+def test_lookup_deadline_fails_loudly():
+    # a lookup past lookup_deadline_ms FAILS: ok=False and zeroed counts
+    # (the runLookupLoop valueOr branch the runtime logs as
+    # "Lookup failed") — force it with a tiny deadline
+    n = 64
+    store, st, stage, lat, svc_keys, _ = _advertised_store(n, [7])
+    params = SDParams(k_store=4, lookup_deadline_ms=1.0)
+    res, _ = lookup(store, st, jnp.asarray([3], jnp.int32),
+                    jnp.zeros((1,), jnp.int32), svc_keys, stage, lat,
+                    jnp.float32(1000.0), params)
+    assert not bool(res.ok[0])
+    assert int(res.unique_peers[0]) == 0
+    assert int(res.advertisements[0]) == 0
+    assert float(res.latency_ms[0]) > 1.0
+
+
 def test_sd_simulator_end_to_end():
     cfg = SDConfig(network_size=40, n_bootstrap=2, n_advertisers=4,
                    n_discoverers=4, services=["svc-a"],
