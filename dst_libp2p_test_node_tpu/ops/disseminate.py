@@ -129,6 +129,38 @@ class DisseminationResult:
     iwant_sent: jnp.ndarray    # (N,) int32 IWANTs sent per peer
 
 
+def _stage_select(stage: jnp.ndarray, n_stages: int, conns: jnp.ndarray,
+                  rev: jnp.ndarray) -> jnp.ndarray:
+    """(N, C, S+1) one-hot of each neighbor slot's stage id. The naive
+    2-index gather lat[stage[p], stage[conns[p,i]]] costs ~60 ms at 100k
+    (scalar gathers); instead: pull each neighbor's stage id through the
+    reverse map (ops/pull.py) and build a fused one-hot over the S+1-wide
+    stage axis — all vectorized."""
+    stage_iota = jnp.arange(n_stages, dtype=jnp.float32)
+    stage_q = neighbor_pull_min(stage.astype(jnp.float32), conns, rev)
+    return stage_q[..., None] == stage_iota
+
+
+def edge_tables(stage, lat_ms, conns, rev, loss_stage=None):
+    """Precompute the per-slot stage-pair tables disseminate() needs:
+    lat_edge[p, i] = lat_ms[stage[p], stage[conns[p, i]]] (0 on pads) and,
+    when loss_stage is given, the same contraction of the loss matrix.
+
+    These are LOOP-INVARIANT ACROSS PUBLISHES (graph and topology are
+    experiment constants) but were being rebuilt inside every disseminate
+    call — 71.8 ms/publish at 100k peers, measured r4. The Simulator
+    computes them once per experiment and passes them through
+    disseminate(lat_edge=..., loss_edge=...); direct callers that skip
+    them get the identical in-call fallback."""
+    sel = _stage_select(stage, lat_ms.shape[0], conns, rev)
+    lat_edge = jnp.where(sel, lat_ms[stage][:, None, :], 0.0).sum(axis=-1)
+    loss_edge = None
+    if loss_stage is not None:
+        loss_edge = jnp.where(
+            sel, loss_stage[stage][:, None, :], 0.0).sum(axis=-1)
+    return lat_edge, loss_edge
+
+
 def _ranks_f32(priority: jnp.ndarray) -> jnp.ndarray:
     return jnp.argsort(jnp.argsort(priority, axis=-1), axis=-1).astype(jnp.float32)
 
@@ -163,6 +195,8 @@ def disseminate(
     return_plan: bool = False,
     bw_down_mbit_per_stage=None,
     loss_mode: str = "tcp",
+    lat_edge=None,
+    loss_edge=None,
 ):
     """Propagate one application message (all fragments) through the mesh.
 
@@ -241,20 +275,19 @@ def disseminate(
     # q before q's downlink drains earlier messages plus this copy
     rx_const = state.rx_free_ms + rx_ms                                # (N,)
 
-    # per-slot link latency lat[stage[p], stage[conns[p,i]]]. The naive
-    # 2-index form costs ~60 ms at 100k (scalar gathers); instead: row-gather
-    # my stage's latency row (contiguous), pull each neighbor's stage id
-    # through the reverse map (ops/pull.py), and select with a fused one-hot
-    # over the S+1-wide stage axis — all vectorized.
-    n_stages = lat_ms.shape[0]
-    stage_iota = jnp.arange(n_stages, dtype=jnp.float32)
-    # NOTE: this pull runs once at top level, OUTSIDE the fragment vmap —
-    # batch_factor stays 1 (the vmapped pulls below pass fragments)
-    stage_q = neighbor_pull_min(stage.astype(jnp.float32), conns, rev)
-    sel_stage = stage_q[..., None] == stage_iota
-    lat_edge = jnp.where(
-        sel_stage, lat_ms[stage][:, None, :], 0.0
-    ).sum(axis=-1)                                        # (N, C); 0 on pads
+    # per-slot link latency lat[stage[p], stage[conns[p,i]]] (and the loss
+    # contraction when needed): experiment constants — callers that loop
+    # over publishes precompute them via edge_tables(); the fallback here
+    # keeps one-shot calls self-contained. NOTE: the stage pull runs once
+    # at top level, OUTSIDE the fragment vmap — batch_factor stays 1 (the
+    # vmapped pulls below pass fragments).
+    if lat_edge is None or (loss_stage is not None and loss_edge is None):
+        lat_edge_c, loss_edge_c = edge_tables(
+            stage, lat_ms, conns, rev, loss_stage)
+        if lat_edge is None:
+            lat_edge = lat_edge_c                         # (N, C); 0 on pads
+        if loss_edge is None:
+            loss_edge = loss_edge_c
 
     # forwarding targets: mesh members; the publisher flood-publishes to every
     # connected topic peer (main.nim:279)
@@ -278,8 +311,6 @@ def disseminate(
         raise ValueError(f"unknown loss_mode {loss_mode!r}")
     retx_ms = None
     if loss_stage is not None:
-        loss_edge = jnp.where(
-            sel_stage, loss_stage[stage][:, None, :], 0.0).sum(axis=-1)
         if loss_mode == "tcp":
             # geometric retransmission count per directed edge (see the
             # model constants above): P(j >= k) = p^k via the inverse-CDF
@@ -499,6 +530,10 @@ def disseminate(
                 t_rx, jnp.maximum(pull(cand).min(axis=-1), rx_const))
             return t_new, jnp.any(t_new < t_rx), it + 1
 
+        # (a mesh-only pre-relaxation before the full loop was measured
+        # NET-WORSE here r4: the per-iteration cost is pull-dominated, so
+        # skipping the gossip candidate arithmetic saves little while the
+        # extra warm-up iterations add whole pulls)
         t_rx, _, _ = jax.lax.while_loop(cond, body, (t0, jnp.bool_(True), 0))
         return t_rx
 

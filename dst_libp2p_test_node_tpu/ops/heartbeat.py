@@ -34,6 +34,14 @@ def _ranks(priority: jnp.ndarray) -> jnp.ndarray:
     return jnp.argsort(jnp.argsort(priority, axis=-1), axis=-1)
 
 
+def _apply_decay(arr: jnp.ndarray, scale, params: SimParams) -> jnp.ndarray:
+    """Geometric decay with the zero-cutoff: where(arr*scale < z, 0, ...).
+    The one formula behind per-step decay, deferred-scale score reads, and
+    the end-of-scan materialization — keep them identical."""
+    eff = arr * scale
+    return jnp.where(eff < params.decay_to_zero, 0.0, eff)
+
+
 def _reciprocal_view(
     edge_mask: jnp.ndarray, conns: jnp.ndarray, rev: jnp.ndarray,
     batch_factor: int = 1,
@@ -61,6 +69,7 @@ def heartbeat_step(
     batch_factor: int = 1,
     nbr_ok: jnp.ndarray | None = None,
     valid_pre: jnp.ndarray | None = None,
+    decay_scales=None,
 ) -> SimState:
     """`batch_factor`: width of any enclosing vmap (e.g. the topic axis of
     runtime/multitopic.py) so the pull memory dispatch sees the true
@@ -70,7 +79,16 @@ def heartbeat_step(
     (run_heartbeats); XLA cannot prove loop-carried state invariant itself.
     `valid_pre`: the fully-assembled edge validity mask, hoisting the
     remaining per-step (N, C) conjunction too — the steady-state round is
-    then one reduce plus cond probes."""
+    then one reduce plus cond probes.
+
+    `decay_scales`: optional (fmd_scale, slow_scale) f32 scalars — the
+    DEFERRED-decay protocol run_heartbeats uses. Score decay is a pure
+    geometric shrink with a zero-cutoff, so across a scan it factors into
+    one scalar per array: this step then touches NO (N, C) decay arrays
+    (the caller materializes arr * scale with the cutoff once, after the
+    scan), and any score read inside the cond branches applies the scale +
+    cutoff on the fly — exactly the per-step-decayed value, because decay
+    is monotone (once below decay_to_zero, always below)."""
     n, c = conns.shape
     key, k_graft, k_keep, k_churn_d, k_churn_u = jax.random.split(state.key, 5)
     t = state.t_ms
@@ -98,15 +116,27 @@ def heartbeat_step(
 
     mesh = state.mesh_mask & valid  # drop edges to dead/unsubscribed peers
     deg = mesh.sum(axis=-1)
+
+    def _score_now():
+        if decay_scales is None:
+            return state.score(params)
+        # deferred decay: reconstruct this step's exact decayed view and
+        # delegate the score formula to the one place it lives
+        f_sc, s_sc = decay_scales
+        return state.replace(
+            fmd=_apply_decay(state.fmd, f_sc, params),
+            slow_penalty=_apply_decay(state.slow_penalty, s_sc, params),
+        ).score(params)
+
     # score() is only consumed inside the cond-gated graft/prune/og branches;
     # computing it lazily there keeps the steady-state step score-free. With
     # opportunistic grafting enabled the og block needs scores every step
     # anyway — compute once and share instead of once per branch.
     _og_enabled = params.opportunistic_graft_threshold > -9999.0
-    _scores = state.score(params) if _og_enabled else None
+    _scores = _score_now() if _og_enabled else None
 
     def get_scores():
-        return _scores if _scores is not None else state.score(params)
+        return _scores if _scores is not None else _score_now()
 
     # -- GRAFT: |mesh| < D_low -> add random eligible peers up to D ----------
     # The whole selection (uniform draw + double argsort + reciprocal pull)
@@ -214,22 +244,23 @@ def heartbeat_step(
         )
 
     # -- score decay (decayInterval == heartbeat here; main.nim:272-273) -----
-    # gated: once everything decayed to zero (no recent messages) the two
-    # (N, C) rewrite passes per step are skipped
-    def do_decay(fmd, slow):
-        fmd = fmd * params.fmd_decay
-        fmd = jnp.where(fmd < params.decay_to_zero, 0.0, fmd)
-        slow = slow * params.slow_decay
-        slow = jnp.where(slow < params.decay_to_zero, 0.0, slow)
-        return fmd, slow
+    if decay_scales is not None:
+        # deferred: the scan carries the scalar scales; arrays untouched
+        fmd, slow = state.fmd, state.slow_penalty
+    else:
+        # gated: once everything decayed to zero (no recent messages) the
+        # two (N, C) rewrite passes per step are skipped
+        def do_decay(fmd, slow):
+            return (_apply_decay(fmd, params.fmd_decay, params),
+                    _apply_decay(slow, params.slow_decay, params))
 
-    fmd, slow = jax.lax.cond(
-        # one fused (N, C) reduce for the predicate, not one per array
-        ((state.fmd > 0) | (state.slow_penalty > 0)).any(),
-        do_decay,
-        lambda f, s: (f, s),
-        state.fmd, state.slow_penalty,
-    )
+        fmd, slow = jax.lax.cond(
+            # one fused (N, C) reduce for the predicate, not one per array
+            ((state.fmd > 0) | (state.slow_penalty > 0)).any(),
+            do_decay,
+            lambda f, s: (f, s),
+            state.fmd, state.slow_penalty,
+        )
 
     # -- fanout expiry (v1.1 fanoutTTL): a fanout set whose owner hasn't
     # fanout-published within the TTL is dropped wholesale (nim-libp2p
@@ -284,10 +315,21 @@ def run_heartbeats(
         valid_pre = ((conns >= 0) & state.alive[:, None] & nbr_ok
                      & state.subscribed[:, None])
 
-    def body(s, _):
-        return heartbeat_step(
+    def body(carry, _):
+        s, f_sc, s_sc = carry
+        s = heartbeat_step(
             s, conns, rev, out_mask, params, nbr_ok=nbr_ok,
-            valid_pre=valid_pre), None
+            valid_pre=valid_pre, decay_scales=(f_sc, s_sc))
+        # the step's end-of-round decay, factored to two scalar multiplies
+        return (s, f_sc * params.fmd_decay, s_sc * params.slow_decay), None
 
-    state, _ = jax.lax.scan(body, state, None, length=steps)
-    return state
+    one = jnp.float32(1.0)
+    (state, f_sc, s_sc), _ = jax.lax.scan(
+        body, (state, one, one), None, length=steps)
+    # materialize the deferred decay ONCE per scan (vs two (N, C) passes
+    # plus a predicate reduce per round): exact, because geometric decay
+    # with a monotone zero-cutoff commutes with deferral
+    return state.replace(
+        fmd=_apply_decay(state.fmd, f_sc, params),
+        slow_penalty=_apply_decay(state.slow_penalty, s_sc, params),
+    )

@@ -143,6 +143,13 @@ class MultiTopicSimulator:
             jnp.asarray(self.topology.packet_loss)
             if float(np.max(self.topology.packet_loss)) > 0.0 else None
         )
+        # stage-pair edge tables: experiment constants, built once (the
+        # tiled stage/conns arrays make them valid across topic blocks)
+        from ..ops.disseminate import edge_tables
+
+        self._lat_edge, self._loss_edge = edge_tables(
+            self._stage, self._lat, self.arrays["conns"], self.arrays["rev"],
+            self._loss)
 
         rng = np.random.default_rng(np.random.SeedSequence([cfg.seed, 0x709]))
         self.subscribed_np = np.ones((tcount, n), dtype=bool)
@@ -160,12 +167,15 @@ class MultiTopicSimulator:
             subscribed=jnp.asarray(self.subscribed_np.reshape(-1)),
             hb_phase=jnp.asarray(np.tile(phase_node, tcount)))
         if mesh is not None:
-            from ..parallel.sharding import place_simulation
+            from ..parallel.sharding import place_simulation, reshard_rows
 
             (self.state, self.arrays, self._stage, self._lat, self._bw,
              self._loss) = place_simulation(
                 self.state, self.arrays, self._stage, self._lat, self._bw,
                 self._loss, mesh)
+            self._lat_edge = reshard_rows(self._lat_edge, mesh)
+            if self._loss_edge is not None:
+                self._loss_edge = reshard_rows(self._loss_edge, mesh)
         self._hb_carry_ms = 0.0
         self.records: list[tuple[str, MessageRecord]] = []
         self._msg_rng = np.random.default_rng(cfg.seed ^ 0x6D736749)
@@ -243,6 +253,8 @@ class MultiTopicSimulator:
             mesh=self.mesh,
             loss_stage=self._loss,
             loss_mode=self.cfg.loss_mode,
+            lat_edge=self._lat_edge,
+            loss_edge=self._loss_edge,
             with_fanout=not bool(self.subscribed_np[ti][publisher]),
         )
         # one uplink per physical NODE: fold the per-row occupancy across
