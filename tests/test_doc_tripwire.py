@@ -84,6 +84,37 @@ def test_parity_flagship_number_matches_artifact():
         )
 
 
+def test_validity_doc_matches_anchor_artifact():
+    # docs/VALIDITY.md quotes the Ethereum-anchor run's numbers; they must
+    # be the committed docs/VALIDITY_ANCHOR.json values (same drift class
+    # as the PARITY flagship number)
+    with open(os.path.join(ROOT, "docs", "VALIDITY_ANCHOR.json")) as f:
+        anchor = json.load(f)["ours"]
+    doc = _read(os.path.join("docs", "VALIDITY.md"))
+    m = re.search(r"\| p50 dissemination \| \*\*(\d+) ms\*\* \|", doc)
+    assert m, "VALIDITY.md must quote '| p50 dissemination | **<n> ms** |'"
+    assert int(m[1]) == round(anchor["p50_ms"]), (m[1], anchor["p50_ms"])
+    m = re.search(r"\| max \| (\d+) ms \|", doc)
+    assert m and int(m[1]) == round(anchor["max_ms"]), (
+        "VALIDITY.md max must quote the artifact", anchor["max_ms"])
+
+
+def test_metric_of_record_quote_matches_artifact():
+    # README/PARITY quote the single-chip peers*rounds/s headline; it must
+    # be the committed bench output (docs/BENCH_LOCAL_r4.json), same drift
+    # class as the ladder table
+    with open(os.path.join(ROOT, "docs", "BENCH_LOCAL_r4.json")) as f:
+        bench = json.load(f)
+    want = f"{bench['value'] / 1e6:.1f}M"
+    for name in ("README.md", "PARITY.md"):
+        doc = _read(name)
+        m = re.search(r"(\d+\.\d)M\s*\n?\s*peer", doc)
+        assert m, f"{name} must quote the metric-of-record as '<n.n>M peer…'"
+        assert f"{m[1]}M" == want, (
+            f"{name} quotes {m[1]}M peers*rounds/s; committed bench artifact "
+            f"says {want} — update the doc")
+
+
 def test_parity_test_file_count_matches_tree():
     parity = _read("PARITY.md")
     m = re.search(r"(\d+)\s+test files", parity)
