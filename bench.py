@@ -90,17 +90,28 @@ def main() -> None:
     profile_dir = os.environ.get("BENCH_PROFILE_DIR", "")
     prof = (jax.profiler.trace(profile_dir) if profile_dir
             else contextlib.nullcontext())  # op-level traces on demand
-    t0 = time.time()
-    with prof:
-        # keep every timed message's result (device arrays — holding them
-        # adds no syncs, so dispatch overlap inside the loop is unchanged)
-        results = []
-        for i in range(MESSAGES):
-            state = hb(state, per_burst)
-            res, state = publish(state, 4 + i)
-            results.append(res)
-        jax.block_until_ready(state.mesh_mask)
-    wall = time.time() - t0
+    # min over reps from the SAME post-warm-up state (the pytree is
+    # immutable, so each rep replays the identical workload): host noise
+    # on this box is ±20% and min is the contention-robust estimator —
+    # the same methodology the config ladder uses. Only rep 0 runs under
+    # the optional profiler trace: one clean capture of the workload, and
+    # the profiling overhead stays out of the reps the min is taken over.
+    state0 = state
+    wall = float("inf")
+    for rep in range(3):
+        state = state0
+        t0 = time.time()
+        with prof if rep == 0 else contextlib.nullcontext():
+            # keep every timed message's result (device arrays — holding
+            # them adds no syncs, so dispatch overlap inside the loop is
+            # unchanged)
+            results = []
+            for i in range(MESSAGES):
+                state = hb(state, per_burst)
+                res, state = publish(state, 4 + i)
+                results.append(res)
+            jax.block_until_ready(state.mesh_mask)
+        wall = min(wall, time.time() - t0)
     # per-phase split from a SEPARATE instrumented pass: the inner syncs it
     # needs would change dispatch overlap inside the metric-of-record loop,
     # so they must not ride there

@@ -87,6 +87,34 @@ def mesh_setup(*, n=100, connect_to=10, seed=0, hb=10, **over):
     return g, params, state, a, topo
 
 
+def test_edge_tables_precompute_equals_in_call_fallback():
+    # the Simulator precomputes the stage-pair tables once per experiment
+    # (r4 perf); a direct call computes them in-call — same sampled plan
+    # (identical key consumption), so results must be IDENTICAL, with and
+    # without loss
+    from dst_libp2p_test_node_tpu.ops.disseminate import edge_tables
+
+    g, params, state, a, (stage, lat, bw) = mesh_setup(seed=6)
+    loss = jnp.full((6, 6), 0.2, jnp.float32)
+    lat_edge, loss_edge = edge_tables(stage, lat, a["conns"], a["rev"], loss)
+    for ls, le in ((None, None), (loss, loss_edge)):
+        r_fall, s_fall = disseminate(
+            state, a["conns"], a["rev"], stage, lat, bw, publisher=3,
+            t0_ms=float(state.t_ms), params=params, payload_bytes=15000,
+            with_gossip=True, loss_stage=ls)
+        r_pre, s_pre = disseminate(
+            state, a["conns"], a["rev"], stage, lat, bw, publisher=3,
+            t0_ms=float(state.t_ms), params=params, payload_bytes=15000,
+            with_gossip=True, loss_stage=ls, lat_edge=lat_edge,
+            loss_edge=(le if ls is not None else None))
+        np.testing.assert_array_equal(
+            np.asarray(r_fall.received), np.asarray(r_pre.received))
+        np.testing.assert_array_equal(
+            np.asarray(r_fall.delay_ms), np.asarray(r_pre.delay_ms))
+        np.testing.assert_array_equal(
+            np.asarray(s_fall.uplink_free_ms), np.asarray(s_pre.uplink_free_ms))
+
+
 def test_full_coverage_100_peers():
     g, params, state, a, (stage, lat, bw) = mesh_setup()
     res, s2 = disseminate(
