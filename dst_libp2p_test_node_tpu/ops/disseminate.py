@@ -105,9 +105,10 @@ INF = jnp.float32(3.4e38)
 #                   RTTVAR ~ RTT/8 at steady state; Linux clamps at
 #                   tcp_rto_min = 200 ms)
 #   retx delay(j) = sum_{k<j} RTO * 2^k = RTO * (2^j - 1)   after j failures
-#   j ~ Geometric(p): P(j >= k) = p^k, sampled once per message per
-#                   directed edge (the granularity the whole loss model
-#                   uses; per-packet re-draws are below it)
+#   j ~ Geometric(p): P(j >= k) = p^k, sampled once per FRAGMENT per
+#                   directed edge (each fragment is a distinct GossipSub
+#                   message upstream; per-packet re-draws are below the
+#                   model's granularity)
 #   j > MAX_RETRIES -> the copy is abandoned (prob p^(MAX_RETRIES+1);
 #                   at topogen-scale loss rates this is negligible, so
 #                   coverage stays ~1.0 and the loss knob moves p99 —
@@ -311,11 +312,18 @@ def disseminate(
         raise ValueError(f"unknown loss_mode {loss_mode!r}")
     retx_ms = None
     if loss_stage is not None:
+        # one independent draw per (FRAGMENT, directed edge): each fragment
+        # is a distinct GossipSub message upstream (main.nim:177-179 flips
+        # the fragment byte precisely so the msgId hash differs), so its
+        # packets face the lossy link independently — correlated
+        # per-message draws would black out every fragment of a message on
+        # an unlucky edge at once, which no packet-loss process does
         if loss_mode == "tcp":
-            # geometric retransmission count per directed edge (see the
-            # model constants above): P(j >= k) = p^k via the inverse-CDF
+            # geometric retransmission count per edge (see the model
+            # constants above): P(j >= k) = p^k via the inverse-CDF
             # j = floor(log u / log p); j > MAX_RETRIES abandons the copy
-            u = jnp.clip(jax.random.uniform(k_loss, (n, c)), 1e-12)
+            u = jnp.clip(jax.random.uniform(k_loss, (fragments, n, c)),
+                         1e-12)
             safe_p = jnp.clip(loss_edge, 1e-9, 1.0 - 1e-9)
             j = jnp.where(
                 loss_edge > 0.0,
@@ -328,12 +336,12 @@ def disseminate(
             retx_ms = jnp.where(
                 survive & (j > 0.0), rto * (jnp.exp2(j) - 1.0), 0.0)
         else:
-            # per-edge message loss (see docstring): the edge's stage-pair
-            # loss rate, sampled once per message per directed edge.
-            # `survive` gates DELIVERY only — a lost copy was still
-            # transmitted, so it keeps its uplink queue slot and its
-            # tx-byte accounting; it just never arrives
-            survive = jax.random.uniform(k_loss, (n, c)) >= loss_edge
+            # whole-copy loss (see docstring): `survive` gates DELIVERY
+            # only — a lost copy was still transmitted, so it keeps its
+            # uplink queue slot and its tx-byte accounting; it just never
+            # arrives
+            survive = (jax.random.uniform(k_loss, (fragments, n, c))
+                       >= loss_edge)
     else:
         survive = None
     if thresholds_can_bind:
@@ -428,6 +436,14 @@ def disseminate(
     # lat_edge — they are single small packets on their own send.
     lat_deliver = lat_edge if retx_ms is None else lat_edge + retx_ms
 
+    def _frag_slice(x, frag_idx):
+        """Per-fragment view of a possibly-(F, N, C) array. Loss/retx draws
+        are per fragment (leading axis); graylist-only survive masks and
+        the lossless lat_deliver are (N, C), shared across fragments."""
+        if x is None or x.ndim == 2:
+            return x
+        return x[frag_idx.astype(jnp.int32)]
+
     def offers(t_rx, rank, k_p, frag_idx, send_mask, deliver_only=False):
         """Arrival-time offers made by every peer on every neighbor slot.
         `deliver_only`: additionally mask copies the network loses — use for
@@ -435,21 +451,23 @@ def disseminate(
         leave False for transmit-side accounting (sends, tx bytes)."""
         base = t_rx + params.proc_delay_ms
         start = jnp.maximum(base, uplink)
+        ld = _frag_slice(lat_deliver, frag_idx)
         # uplink serialization: (rank+1) sends of this fragment, plus the
         # frag_idx earlier fragments each occupying k_p uplink slots
         queue = (rank + 1.0 + frag_idx * k_p[:, None]) * tx_ms[:, None]
-        cand = start[:, None] + queue + lat_deliver
+        cand = start[:, None] + queue + ld
         live = can_send[:, None] & (t_rx[:, None] < INF)
         sm = send_mask
         gm = g_tgt
         if deliver_only and survive is not None:
-            sm = sm & survive
-            gm = gm & survive
+            sv = _frag_slice(survive, frag_idx)
+            sm = sm & sv
+            gm = gm & sv
         cand = jnp.where(sm & live, cand, INF)
         if with_gossip:
             hb = _next_heartbeat(base, hb_phase, params.heartbeat_ms)
             g = jnp.maximum(hb[:, None] + g_off, uplink[:, None]) \
-                + 2.0 * lat_edge + lat_deliver + tx_ms[:, None]
+                + 2.0 * lat_edge + ld + tx_ms[:, None]
             cand = jnp.minimum(cand, jnp.where(gm & live, g, INF))
         return cand
 
@@ -470,8 +488,10 @@ def disseminate(
         # arrival times are about DELIVERY: lost copies never relax an edge
         # (their queue slots still count — rank/k_p came from the unmasked
         # send set)
-        deliver = send_mask if survive is None else send_mask & survive
-        g_deliver = g_tgt if survive is None else g_tgt & survive
+        sv = _frag_slice(survive, frag_idx)
+        ld = _frag_slice(lat_deliver, frag_idx)
+        deliver = send_mask if sv is None else send_mask & sv
+        g_deliver = g_tgt if sv is None else g_tgt & sv
         if mesh is not None:
             # sharded: receiver-local constants, one (N,) all-gather + one
             # psum per iteration over ICI (parallel/exchange.py)
@@ -479,7 +499,7 @@ def disseminate(
                 conns, rev, lat_edge, tx_ms, rank, k_p, frag_idx, deliver,
                 can_send, g_deliver, g_off, hb_phase, uplink, rx_const,
                 params.proc_delay_ms, params.heartbeat_ms, with_gossip,
-                retx_ms=retx_ms,
+                retx_ms=_frag_slice(retx_ms, frag_idx),
             )
             return converge_sharded(t0, c, params.max_relax_iters, mesh)
         if exceeds_budget(jnp.float32, conns.shape, fragments):
@@ -494,7 +514,7 @@ def disseminate(
                 conns, rev, lat_edge, tx_ms, rank, k_p, frag_idx, deliver,
                 can_send, g_deliver, g_off, hb_phase, uplink, rx_const,
                 params.proc_delay_ms, params.heartbeat_ms, with_gossip,
-                retx_ms=retx_ms,
+                retx_ms=_frag_slice(retx_ms, frag_idx),
             )
             return converge_recv(t0, c, params.max_relax_iters)
         # single device below the budget: sender-major offers (loop-invariant
@@ -502,10 +522,10 @@ def disseminate(
         # per-iteration speed of a receiver-side index gather (ops/pull.py)
         queue = (rank + 1.0 + frag_idx * k_p[:, None]) * tx_ms[:, None]
         a_base = jnp.where(
-            deliver & can_send[:, None], queue + lat_deliver, INF)
+            deliver & can_send[:, None], queue + ld, INF)
         g_base = jnp.where(
             g_deliver & can_send[:, None],
-            2.0 * lat_edge + lat_deliver + tx_ms[:, None], INF)
+            2.0 * lat_edge + ld + tx_ms[:, None], INF)
 
         def cond(carry):
             _, changed, it = carry
@@ -617,6 +637,7 @@ def disseminate(
 
     # ---- post-fixpoint accounting (bytes, duplicates, gossip, score) -------
     def frag_accounting(frag_idx, t_rx_one, rank, k_p, send_mask):
+        sv = _frag_slice(survive, frag_idx)   # this fragment's loss draw
         # tx side (sends, bytes): everything transmitted, lost or not
         cand = offers(t_rx_one, rank, k_p, frag_idx, send_mask)
         made_offer = cand < INF
@@ -665,12 +686,12 @@ def disseminate(
                 ans_start_h = jnp.maximum(
                     hb[:, None] + h * params.heartbeat_ms, uplink[:, None])
                 ans_h = active_h & (q_t > ans_start_h + lat_edge)
-                if survive is not None:
+                if sv is not None:
                     # a graylisted/lossy edge never delivers the IHAVE, so no
                     # IWANT comes back and no answer is transmitted — the
                     # control/byte accounting matches the fixpoint's
                     # g_deliver = g_tgt & survive delivery gating
-                    ans_h = ans_h & survive
+                    ans_h = ans_h & sv
                 gossip_sent = gossip_sent | ans_h
                 best_h = jnp.where(ans_h, jnp.float32(h), best_h)
             # answered IWANTs serialize on the answering uplink: IHAVE out at
@@ -693,7 +714,7 @@ def disseminate(
             iwant_rx_pp = gossip_sent.sum(axis=-1).astype(jnp.float32)
             sends = sends + (gossip_sent & made_offer).sum(axis=-1)
             sent_any = eff_send | (gossip_sent & made_offer)
-            arrived = sent_any if survive is None else sent_any & survive
+            arrived = sent_any if sv is None else sent_any & sv
             # ONE pull for all three involution-crossing quantities: the
             # per-edge IHAVE count (<= history_gossip), the IWANT flag and
             # the delivered-copy flag pack exactly into one small float —
@@ -720,7 +741,7 @@ def disseminate(
             iwant_rx_pp = jnp.zeros((n,), jnp.float32)
             sent_any = eff_send
             # receivers only count copies the network actually delivered
-            arrived = sent_any if survive is None else sent_any & survive
+            arrived = sent_any if sv is None else sent_any & sv
             arrived_rx = reciprocal_pull_bool(
                 arrived, conns, rev, batch_factor=fragments)
             copies = arrived_rx.sum(axis=-1).astype(jnp.float32)
@@ -847,9 +868,10 @@ def disseminate(
             "tgt": tgt,                 # (N, C) data send set (pre queue-drop)
             "rprio": rprio,             # (N, C) send-order priorities
             "g_tgt_w": g_tgt_w,         # (W, N, C) per-round gossip targets
-            "survive": survive,         # (N, C) bool or None (loss)
-            "retx_ms": retx_ms,         # (N, C) tcp-mode retransmit stall
-            #                             per delivered copy, or None
+            "survive": survive,         # (F, N, C) per-fragment loss draws,
+            #                             (N, C) graylist-only, or None
+            "retx_ms": retx_ms,         # (F, N, C) tcp-mode retransmit
+            #                             stall per delivered copy, or None
             "hb_phase": hb_phase,       # (N,)
             "uplink": uplink,           # (N,) pre-message uplink occupancy
             "rx_free": state.rx_free_ms,  # (N,) pre-message downlink occupancy

@@ -64,28 +64,43 @@ class _Model:
         self.rxc = self.rxf + self.rxm   # downlink clamp per receiver
         self.can = np.asarray(plan["can_send"])
         self.gw = np.asarray(plan["g_tgt_w"])
-        surv = plan["survive"]
-        self.surv = (np.ones_like(self.conns, bool) if surv is None
-                     else np.asarray(surv))
-        retx = plan.get("retx_ms")
+        # loss draws are per (fragment, edge) — (F, N, C); a graylist-only
+        # survive mask is (N, C), shared across fragments. Normalize both
+        # to 3-D indexed by [frag, p, i].
+        def _to_3d(x, fill):
+            if x is None:
+                return np.broadcast_to(fill, (1,) + self.conns.shape)
+            x = np.asarray(x)
+            return x[None] if x.ndim == 2 else x
+
+        self.surv = _to_3d(plan["survive"], np.ones((), bool))
         # tcp loss mode: per-edge retransmission stall of the data-carrying
         # traversal (added once per delivery, not to control round trips)
-        self.retx = (np.zeros_like(self.lat) if retx is None
-                     else np.asarray(retx, np.float64))
+        self.retx = _to_3d(plan.get("retx_ms"),
+                           np.zeros((), np.float64)).astype(np.float64)
         self.proc = params.proc_delay_ms
         self.hb = params.heartbeat_ms
         self.n, self.c = self.conns.shape
 
+    def sv(self, frag):
+        """This fragment's survive mask (modulo handles the shared 2-D
+        graylist-only / lossless case normalized to one leading row)."""
+        return self.surv[frag % self.surv.shape[0]]
+
+    def rx_stall(self, frag):
+        return self.retx[frag % self.retx.shape[0]]
+
     def offer(self, p, i, t_p, send_mask, rank, k, frag):
         """Best arrival a copy from p's slot i can achieve given t_rx[p]."""
-        if not self.can[p] or t_p >= INF_CUT or not self.surv[p, i]:
+        if not self.can[p] or t_p >= INF_CUT or not self.sv(frag)[p, i]:
             return math.inf
+        retx_pi = self.rx_stall(frag)[p, i]
         base = t_p + self.proc
         best = math.inf
         if send_mask[p, i]:
             start = max(base, self.up[p])
             best = (start + (rank[p, i] + 1.0 + frag * k[p]) * self.tx[p]
-                    + self.lat[p, i] + self.retx[p, i])
+                    + self.lat[p, i] + retx_pi)
         tick = (math.floor((base - self.ph[p]) / self.hb) + 1.0) * self.hb \
             + self.ph[p]
         for h in range(self.gw.shape[0]):
@@ -93,7 +108,7 @@ class _Model:
                 # IHAVE out + IWANT back ride clean control packets; only
                 # the answering data send suffers the retransmission stall
                 best = min(best, max(tick + h * self.hb, self.up[p])
-                           + 3.0 * self.lat[p, i] + self.retx[p, i]
+                           + 3.0 * self.lat[p, i] + retx_pi
                            + self.tx[p])
         return best
 
@@ -195,7 +210,7 @@ def des_delays(conns, rev, plan, params, publisher, t0_ms, fragments,
                                       and t1[q] + m.lat[p, i] < slot_start)
                         if not suppressed:
                             last_pos = max(last_pos, rank_f[p, i] + 1.0)
-                            if m.surv[p, i]:
+                            if m.sv(f)[p, i]:
                                 rx_arrivals[q].append(
                                     m.offer(p, i, t1[p], send_f, rank_f,
                                             k_f, f))
@@ -204,7 +219,7 @@ def des_delays(conns, rev, plan, params, publisher, t0_ms, fragments,
                     # and delivers one copy
                     answered = False
                     for h in range(m.gw.shape[0]):
-                        if not m.gw[h, p, i] or not m.surv[p, i]:
+                        if not m.gw[h, p, i] or not m.sv(f)[p, i]:
                             continue
                         ans_start = max(tick + h * m.hb, m.up[p])
                         if t1[q] > ans_start + m.lat[p, i]:

@@ -254,6 +254,37 @@ def test_multi_round_gossip_recovers_lossy_edges():
     assert cov[3] > cov[1], cov
 
 
+def test_loss_draws_are_per_fragment():
+    # each fragment is a distinct GossipSub message upstream (the fragment
+    # byte flips the msgId hash, main.nim:177-179), so loss must be drawn
+    # independently per (fragment, edge) — correlated draws would black
+    # out every fragment of a message on an unlucky edge at once
+    g, params, state, a, (stage, lat, bw) = mesh_setup(seed=9)
+    loss = jnp.full((6, 6), 0.3, jnp.float32)
+    _, _, plan = disseminate(
+        state, a["conns"], a["rev"], stage, lat, bw, publisher=0,
+        t0_ms=float(state.t_ms), params=params, payload_bytes=15000,
+        fragments=3, with_gossip=True, loss_stage=loss,
+        loss_mode="message", return_plan=True)
+    surv = np.asarray(plan["survive"])
+    assert surv.shape[0] == 3
+    # the three fragments' draws differ on real edges
+    real = np.asarray(a["conns"]) >= 0
+    assert (surv[0][real] != surv[1][real]).any()
+    assert (surv[1][real] != surv[2][real]).any()
+
+    # tcp mode: the retransmission stalls are per fragment too (distinct
+    # static loss_mode => its own jit cache entry, no eviction needed)
+    _, _, plan_t = disseminate(
+        state, a["conns"], a["rev"], stage, lat, bw, publisher=0,
+        t0_ms=float(state.t_ms), params=params, payload_bytes=15000,
+        fragments=3, with_gossip=True, loss_stage=loss,
+        loss_mode="tcp", return_plan=True)
+    retx = np.asarray(plan_t["retx_ms"])
+    assert retx.shape[0] == 3
+    assert ((retx[0] > 0) != (retx[1] > 0)).any()
+
+
 def test_fragments_complete_on_last():
     g, params, state, a, (stage, lat, bw) = mesh_setup()
     r1, _ = disseminate(
