@@ -317,7 +317,14 @@ def disseminate(
         # the fragment byte precisely so the msgId hash differs), so its
         # packets face the lossy link independently — correlated
         # per-message draws would black out every fragment of a message on
-        # an unlucky edge at once, which no packet-loss process does
+        # an unlucky edge at once, which no packet-loss process does.
+        # Memory note: the draws (and the derived retx/lat_deliver) are
+        # (F, N, C) and live through the whole fragment vmap — generating
+        # them inside the per-fragment body would not lower the peak,
+        # since vmap batches all lanes anyway. At 1M peers this is
+        # ~0.4 GB per f32 array per fragment; lossy runs at extreme N
+        # should keep FRAGMENTS modest (the five BASELINE configs that
+        # reach 1M are lossless and never allocate any of this).
         if loss_mode == "tcp":
             # geometric retransmission count per edge (see the model
             # constants above): P(j >= k) = p^k via the inverse-CDF

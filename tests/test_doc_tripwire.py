@@ -115,6 +115,20 @@ def test_metric_of_record_quote_matches_artifact():
             f"says {want} — update the doc")
 
 
+def test_readme_loss_tail_matches_artifact():
+    # README's loss-model section quotes the tcp-mode deep-backoff tail;
+    # pin it to docs/LOSS_MODES.json like every other quoted artifact
+    with open(os.path.join(ROOT, "docs", "LOSS_MODES.json")) as f:
+        runs = json.load(f)["runs"]
+    tcp_hi = next(r for r in runs
+                  if r["loss_mode"] == "tcp" and r["loss"] >= 0.1)
+    readme = _read("README.md")
+    m = re.search(r"RTO tail \(max ([\d.]+) s", readme)
+    assert m, "README must quote the tcp-mode tail as 'RTO tail (max <n> s'"
+    assert float(m[1]) == pytest.approx(tcp_hi["max_ms"] / 1e3, abs=0.051), (
+        m[1], tcp_hi["max_ms"])
+
+
 def test_parity_test_file_count_matches_tree():
     parity = _read("PARITY.md")
     m = re.search(r"(\d+)\s+test files", parity)
