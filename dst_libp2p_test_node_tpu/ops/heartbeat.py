@@ -70,7 +70,8 @@ def heartbeat_step(
     nbr_ok: jnp.ndarray | None = None,
     valid_pre: jnp.ndarray | None = None,
     decay_scales=None,
-) -> SimState:
+    deg_in: jnp.ndarray | None = None,
+):
     """`batch_factor`: width of any enclosing vmap (e.g. the topic axis of
     runtime/multitopic.py) so the pull memory dispatch sees the true
     allocation size (ops/pull.py). `nbr_ok`: optional precomputed neighbor
@@ -88,7 +89,27 @@ def heartbeat_step(
     (the caller materializes arr * scale with the cutoff once, after the
     scan), and any score read inside the cond branches applies the scale +
     cutoff on the fly — exactly the per-step-decayed value, because decay
-    is monotone (once below decay_to_zero, always below)."""
+    is monotone (once below decay_to_zero, always below).
+
+    `deg_in`: optional carried (N,) mesh degree — the second scan-level
+    protocol (requires `valid_pre`). The caller must have established the
+    invariant mesh_mask ⊆ valid_pre (one AND before the scan); every
+    branch write here re-ANDs with `valid`, so the invariant is
+    preserved, the per-step (N, C) mesh-AND and degree reduce both
+    disappear, and the degree is re-reduced only inside a cond when a
+    branch actually changed the mesh. When given, the step returns
+    (state, deg_out) instead of state."""
+    if deg_in is not None and (
+        valid_pre is None
+        or params.churn_down_per_hb > 0.0
+        or params.churn_up_per_hb > 0.0
+    ):
+        # the carried-degree protocol only makes sense on top of the
+        # hoisted validity mask with churn off; reject misuse loudly (the
+        # degrees would silently count edges to dead/unsubscribed peers,
+        # or the return arity would silently change under churn)
+        raise ValueError("deg_in requires valid_pre and churn off "
+                         "(run_heartbeats' churn-free scan protocol)")
     n, c = conns.shape
     key, k_graft, k_keep, k_churn_d, k_churn_u = jax.random.split(state.key, 5)
     t = state.t_ms
@@ -114,8 +135,15 @@ def heartbeat_step(
                 alive & state.subscribed, conns, rev, batch_factor)
         valid = has_conn & alive[:, None] & nbr_ok & state.subscribed[:, None]
 
-    mesh = state.mesh_mask & valid  # drop edges to dead/unsubscribed peers
-    deg = mesh.sum(axis=-1)
+    if deg_in is not None:
+        # carried-degree protocol: mesh_mask ⊆ valid already (caller's
+        # pre-scan AND + every branch write re-ANDing), so the per-step
+        # mesh-AND and degree reduce are skipped outright
+        mesh = state.mesh_mask
+        deg = deg_in
+    else:
+        mesh = state.mesh_mask & valid  # drop edges to dead/unsubscribed
+        deg = mesh.sum(axis=-1)
 
     def _score_now():
         if decay_scales is None:
@@ -274,7 +302,7 @@ def heartbeat_step(
         state.fanout_mask,
     )
 
-    return state.replace(
+    new_state = state.replace(
         mesh_mask=mesh,
         fanout_mask=fanout,
         backoff_until=backoff,
@@ -288,6 +316,16 @@ def heartbeat_step(
         prunes=state.prunes + prune_tx_inc,
         prunes_rx=state.prunes_rx + prune_rx_inc,
     )
+    if deg_in is None:
+        return new_state
+    # carried degree: re-reduce only if some branch actually touched the
+    # mesh this step — the steady-state round stays free of (N, C) reduces
+    fired = (need > 0).any() | over.any()
+    if params.opportunistic_graft_threshold > -9999.0:
+        fired = fired | og.any()
+    deg_out = jax.lax.cond(
+        fired, lambda m: m.sum(axis=-1), lambda m: deg_in, mesh)
+    return new_state, deg_out
 
 
 @partial(jax.jit, static_argnames=("params", "steps"))
@@ -315,17 +353,36 @@ def run_heartbeats(
         valid_pre = ((conns >= 0) & state.alive[:, None] & nbr_ok
                      & state.subscribed[:, None])
 
-    def body(carry, _):
-        s, f_sc, s_sc = carry
-        s = heartbeat_step(
-            s, conns, rev, out_mask, params, nbr_ok=nbr_ok,
-            valid_pre=valid_pre, decay_scales=(f_sc, s_sc))
-        # the step's end-of-round decay, factored to two scalar multiplies
-        return (s, f_sc * params.fmd_decay, s_sc * params.slow_decay), None
-
     one = jnp.float32(1.0)
-    (state, f_sc, s_sc), _ = jax.lax.scan(
-        body, (state, one, one), None, length=steps)
+    if valid_pre is not None:
+        # carried-degree protocol: establish mesh_mask ⊆ valid ONCE (the
+        # AND every step used to apply), then the steady-state round pays
+        # no (N, C) mesh-AND or degree reduce at all
+        mesh0 = state.mesh_mask & valid_pre
+        state = state.replace(mesh_mask=mesh0)
+
+        def body(carry, _):
+            s, deg, f_sc, s_sc = carry
+            s, deg = heartbeat_step(
+                s, conns, rev, out_mask, params, nbr_ok=nbr_ok,
+                valid_pre=valid_pre, decay_scales=(f_sc, s_sc), deg_in=deg)
+            return (s, deg, f_sc * params.fmd_decay,
+                    s_sc * params.slow_decay), None
+
+        (state, _, f_sc, s_sc), _ = jax.lax.scan(
+            body, (state, mesh0.sum(axis=-1), one, one), None, length=steps)
+    else:
+        def body(carry, _):
+            s, f_sc, s_sc = carry
+            s = heartbeat_step(
+                s, conns, rev, out_mask, params, nbr_ok=nbr_ok,
+                valid_pre=valid_pre, decay_scales=(f_sc, s_sc))
+            # end-of-round decay, factored to two scalar multiplies
+            return (s, f_sc * params.fmd_decay,
+                    s_sc * params.slow_decay), None
+
+        (state, f_sc, s_sc), _ = jax.lax.scan(
+            body, (state, one, one), None, length=steps)
     # materialize the deferred decay ONCE per scan (vs two (N, C) passes
     # plus a predicate reduce per round): exact, because geometric decay
     # with a monotone zero-cutoff commutes with deferral
