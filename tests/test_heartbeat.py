@@ -45,6 +45,50 @@ def test_mesh_subset_of_connections():
     assert not (mesh & (g.conns < 0)).any()
 
 
+def test_scan_equals_stepwise():
+    # run_heartbeats' scan-level protocols (deferred decay scales, carried
+    # mesh degree behind the pre-scan validity AND) claim EXACTNESS: a
+    # k-step scan must equal k standalone heartbeat_step calls. Exercise a
+    # state with live score counters so the decay deferral actually binds.
+    g, params, state, a = make(n=80, connect_to=8, seed=2)
+    state = run_heartbeats(state, a["conns"], a["rev"], a["out_mask"],
+                           params, 3)
+    # nonzero decaying counters + a non-trivial subscription pattern
+    rng = np.random.default_rng(0)
+    state = state.replace(
+        fmd=jnp.asarray(rng.random(state.fmd.shape, np.float32) * 3.0),
+        # big enough that part of the counter SURVIVES 6 rounds of the
+        # aggressive slow_decay (0.2^6 ~ 6.4e-5; values > ~156 stay above
+        # the 0.01 cutoff) — an all-zero comparison would be vacuous
+        slow_penalty=jnp.asarray(
+            rng.random(state.fmd.shape, np.float32) * 500.0),
+        subscribed=jnp.asarray(rng.random(80) < 0.9),
+    )
+
+    scanned = run_heartbeats(state, a["conns"], a["rev"], a["out_mask"],
+                             params, 6)
+    stepped = state
+    for _ in range(6):
+        stepped = heartbeat_step(stepped, a["conns"], a["rev"],
+                                 a["out_mask"], params)
+
+    np.testing.assert_array_equal(np.asarray(scanned.mesh_mask),
+                                  np.asarray(stepped.mesh_mask))
+    np.testing.assert_array_equal(np.asarray(scanned.backoff_until),
+                                  np.asarray(stepped.backoff_until))
+    np.testing.assert_array_equal(np.asarray(scanned.grafts),
+                                  np.asarray(stepped.grafts))
+    np.testing.assert_array_equal(np.asarray(scanned.prunes),
+                                  np.asarray(stepped.prunes))
+    assert float(scanned.t_ms) == float(stepped.t_ms)
+    # decay: mathematically exact; f32 reassociation (scale product vs
+    # per-step multiplies) allows ~1-ulp wobble
+    np.testing.assert_allclose(np.asarray(scanned.fmd),
+                               np.asarray(stepped.fmd), rtol=2e-6)
+    np.testing.assert_allclose(np.asarray(scanned.slow_penalty),
+                               np.asarray(stepped.slow_penalty), rtol=2e-6)
+
+
 def test_clock_advances_and_counters():
     g, params, state, a = make(n=50, connect_to=6)
     s1 = heartbeat_step(state, a["conns"], a["rev"], a["out_mask"], params)
