@@ -45,12 +45,20 @@ def test_mesh_subset_of_connections():
     assert not (mesh & (g.conns < 0)).any()
 
 
-def test_scan_equals_stepwise():
+import pytest
+
+
+@pytest.mark.parametrize("og", [False, True])
+def test_scan_equals_stepwise(og):
     # run_heartbeats' scan-level protocols (deferred decay scales, carried
     # mesh degree behind the pre-scan validity AND) claim EXACTNESS: a
     # k-step scan must equal k standalone heartbeat_step calls. Exercise a
-    # state with live score counters so the decay deferral actually binds.
-    g, params, state, a = make(n=80, connect_to=8, seed=2)
+    # state with live score counters so the decay deferral actually binds;
+    # the og=True case makes opportunistic grafting fire mid-scan, which
+    # exercises the carried-degree re-reduce gate AND the deferred-score
+    # read inside the og branch.
+    over = {"opportunistic_graft_threshold": 5.0} if og else {}
+    g, params, state, a = make(n=80, connect_to=8, seed=2, **over)
     state = run_heartbeats(state, a["conns"], a["rev"], a["out_mask"],
                            params, 3)
     # nonzero decaying counters + a non-trivial subscription pattern
@@ -87,6 +95,10 @@ def test_scan_equals_stepwise():
                                np.asarray(stepped.fmd), rtol=2e-6)
     np.testing.assert_allclose(np.asarray(scanned.slow_penalty),
                                np.asarray(stepped.slow_penalty), rtol=2e-6)
+    if og:
+        # the og branch actually fired during the comparison window (fmd
+        # credit on non-mesh edges pushes candidates above the mesh median)
+        assert int(np.asarray(scanned.grafts).sum()) > 0
 
 
 def test_clock_advances_and_counters():
