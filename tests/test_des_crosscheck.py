@@ -426,6 +426,26 @@ def test_rx_contention_binds_and_moves_p99():
         f"rx contention did not move p99: {p99_with} vs {p99_free}")
 
 
+def test_fixpoint_matches_des_fanout_publisher_tcp_loss():
+    # the untested cross-product: an unsubscribed publisher on the v1.1
+    # fanout path while every edge carries tcp-mode retransmission stalls
+    g, params, state, a, (stage, lat, bw) = _setup(
+        96, 7, 47, 3, flood_publish=False)
+    sub = np.ones(96, bool)
+    sub[11] = False
+    state = state.replace(subscribed=jnp.asarray(sub))
+    loss_stage = jnp.full((4, 4), 0.2, jnp.float32)
+    t0 = float(state.t_ms)
+    res, _, plan = disseminate(
+        state, a["conns"], a["rev"], stage, lat, bw, publisher=11,
+        t0_ms=t0, params=params, payload_bytes=15000, with_gossip=True,
+        with_fanout=True, loss_stage=loss_stage, loss_mode="tcp",
+        return_plan=True)
+    assert np.asarray(plan["retx_ms"]).max() > 0
+    assert int(np.asarray(res.received).sum()) > 80
+    _compare(res, plan, a["conns"], a["rev"], params, 11, t0, 1)
+
+
 def test_fixpoint_matches_des_fanout_publisher():
     # unsubscribed publisher -> gossipsub v1.1 fanout path; the plan's tgt
     # already resolves the fanout set, so the DES needs no special handling.
