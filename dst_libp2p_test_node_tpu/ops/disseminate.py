@@ -20,6 +20,17 @@ drain time of EARLIER messages (SimState): concurrent publishes queue
 behind each other the way the reference's per-connection queues serialize
 all in-flight traffic.
 
+The data-carrying link traversal additionally pays TCP slow-start flight
+dynamics (tcp_flights below): under Shadow the nodes run REAL TCP stacks
+(regression/Dockerfile_amd64_shadow:3-11), so a transfer larger than the
+~14.6 KB initial congestion window needs multiple RTT-gated flights and the
+per-edge delivery latency becomes lat * (1 + 2*(flights-1)) — the flagship
+15 KB message pays +1 RTT per hop, a 128 KB block +3. Publishes are seconds
+apart, so windows slow-start-restart after idling (RFC 2861) and cold is
+the default state; mesh fragments of one message ride a warmed back-to-back
+stream, gossip answers restart cold. Control packets (IHAVE/IWANT/
+IDONTWANT) fit the first window and keep the bare latency.
+
 The outer max is the RECEIVER side of the same bandwidth story: Shadow
 enforces host_bandwidth_down on every host (shadow/topogen.py:50-51), so a
 copy of rx_ms[q] = bytes/bw_down drain time arriving while q's downlink is
@@ -34,15 +45,18 @@ answers included — through the queue in arrival order, exactly).
 Cross-fragment rx contention inside one message is not modeled: same-sender
 fragments are spaced k*tx >= rx_ms apart by the uplink queue, so only
 interleaved different-sender duplicates could bind, a second-order effect.
-Same-round answered-IWANT serialization is likewise approximated: a peer
-answering multiple IWANTs in one gossip round occupies its uplink for the
-MAX of the answer ends, not their sum (the reference's per-connection
-queues would serialize them). Gossip answers are rare duplicates of
-already-disseminated data (the mesh delivers first in the common case), so
-the unmodeled tail is the per-round answer count minus one extra tx each —
-the DES cross-check implements the identical max, so its agreement checks
-implementation, not this approximation.
-The whole model is differentially validated against an independent
+Answered IWANTs SERIALIZE on the answering uplink (gossip_serial below): a
+peer answering k IWANTs in one gossip round transmits the answers
+back-to-back in IWANT-arrival order — sum, not max — and a round's backlog
+spills into the next round's queue, the way the reference's per-connection
+queues all drain through one host_bandwidth_up (main.nim:264-299). The DES
+cross-check reproduces this through a chronological event heap (IHAVE
+arrival -> IWANT -> single-server answer queue), written independently of
+the fixpoint's sorted-prefix fold, so the differential suite discriminates
+exactly this term. (Cross-fragment answer serialization within one message
+remains uncoupled — fragment lanes are vmapped — matching the per-fragment
+independence of everything else inside a message.)
+The whole model is differentially validated against that independent
 host-side event-queue simulator (tests/test_des_crosscheck.py).
 
 The iteration is a *pull*: each peer gathers its neighbors' sender-side
@@ -119,6 +133,39 @@ RTO_MIN_MS = 200.0
 MAX_RETRIES = 6
 
 
+def tcp_flights(nbytes: int, params) -> int:
+    """Number of RTT-gated TCP flights a cold-started transfer of `nbytes`
+    needs. Under Shadow the nodes run real TCP stacks
+    (regression/Dockerfile_amd64_shadow:3-11): the first flight carries at
+    most initcwnd_segments * mss_bytes (Linux IW10, RFC 6928) and the
+    congestion window doubles each RTT while slow-starting, so after F
+    flights IW * (2^F - 1) bytes are out. Messages are published seconds
+    apart (topogen delay_seconds), so connections slow-start-restart after
+    idling (RFC 2861) and EVERY data transfer starts cold — this is the
+    default state, not a corner case. The large-message statistic the
+    reference acknowledges as TxTime-confounded (summary_latency_large.awk:
+    20-24) is exactly this multi-flight effect.
+
+    Closed form: smallest F >= 1 with IW * (2^F - 1) >= nbytes.
+    (The DES cross-check derives the same count with an independent loop
+    formulation — tests/test_des_crosscheck.py.)"""
+    import math
+
+    if not params.slow_start:
+        return 1
+    iw = params.mss_bytes * params.initcwnd_segments
+    if nbytes <= iw:
+        return 1
+    f = max(1, math.ceil(math.log2(nbytes / iw + 1.0)))
+    # integer-exact boundary correction (the float log can land a hair off
+    # when nbytes sits exactly on a window-sum boundary)
+    while f > 1 and iw * (2 ** (f - 1) - 1) >= nbytes:
+        f -= 1
+    while iw * (2 ** f - 1) < nbytes:
+        f += 1
+    return f
+
+
 @struct.dataclass
 class DisseminationResult:
     t_rx_ms: jnp.ndarray       # (N,) absolute full-receipt time, INF if never
@@ -128,6 +175,13 @@ class DisseminationResult:
     copies_rx: jnp.ndarray     # (N,) int32 copies received (>=1 => received)
     ihave_sent: jnp.ndarray    # (N,) int32 IHAVEs sent per peer
     iwant_sent: jnp.ndarray    # (N,) int32 IWANTs sent per peer
+    lost_tx: jnp.ndarray       # (N,) int32 transmitted copies the network
+    #                            never delivered: loss_mode="tcp" abandons a
+    #                            copy after MAX_RETRIES RTOs (prob
+    #                            p^(MAX_RETRIES+1) per fragment-edge), the
+    #                            "message" mode loses it outright. Lossy runs
+    #                            verify the tcp-mode negligibility claim
+    #                            against this counter instead of trusting it.
 
 
 def _stage_select(stage: jnp.ndarray, n_stages: int, conns: jnp.ndarray,
@@ -437,45 +491,181 @@ def disseminate(
     # serialize all in-flight traffic, main.nim:264-299)
     uplink = state.uplink_free_ms
 
-    # effective per-edge delivery latency: the wire latency plus (tcp loss
-    # mode) the sampled retransmission stall of the data-carrying traversal.
+    # effective per-edge delivery latency: the wire latency, times the TCP
+    # slow-start flight count of the data transfer (tcp_flights above: a
+    # transfer needing F cold-start flights pays F-1 extra RTTs = 2*lat
+    # each), plus (tcp loss mode) the sampled retransmission stall.
     # Control messages (IHAVE/IWANT/IDONTWANT timing checks) keep the bare
-    # lat_edge — they are single small packets on their own send.
-    lat_deliver = lat_edge if retx_ms is None else lat_edge + retx_ms
+    # lat_edge — they are single small packets inside the first window.
+    # Mesh fragment f rides a connection the f earlier fragments of the
+    # same back-to-back stream already warmed: its last byte departs in
+    # flight F((f+1)*frag_bytes) of the cold-started stream. A gossip
+    # answer is a single cold transfer — the non-mesh edge idled since the
+    # previous message, so its window restarted. (Retransmission stalls
+    # and flight counts compose additively; a real RTO inside slow start
+    # would also halve the window — a second-order interaction left out.)
+    ss_mesh = tuple(
+        float(tcp_flights((f + 1) * frag_bytes, params) - 1)
+        for f in range(fragments))
+    ss_ans = float(tcp_flights(frag_bytes, params) - 1)
+    ss_scale = jnp.asarray([1.0 + 2.0 * e for e in ss_mesh], jnp.float32)
+    ans_scale = jnp.float32(1.0 + 2.0 * ss_ans)
 
     def _frag_slice(x, frag_idx):
         """Per-fragment view of a possibly-(F, N, C) array. Loss/retx draws
-        are per fragment (leading axis); graylist-only survive masks and
-        the lossless lat_deliver are (N, C), shared across fragments."""
+        are per fragment (leading axis); graylist-only survive masks are
+        (N, C), shared across fragments."""
         if x is None or x.ndim == 2:
             return x
         return x[frag_idx.astype(jnp.int32)]
 
-    def offers(t_rx, rank, k_p, frag_idx, send_mask, deliver_only=False):
+    def _ld_mesh(frag_idx):
+        """Mesh-edge delivery latency of this fragment (slow-start flights
+        x wire latency + sampled retransmission stall)."""
+        ld = lat_edge * ss_scale[frag_idx.astype(jnp.int32)]
+        r = _frag_slice(retx_ms, frag_idx)
+        return ld if r is None else ld + r
+
+    def _ld_ans(frag_idx):
+        """Gossip-answer delivery latency (cold-start flights; same
+        per-edge retransmission draw as the mesh copy — one draw per
+        (fragment, edge), a documented approximation: the answer is a
+        rare duplicate of data the mesh already moved, so an independent
+        re-draw would change only the tail of a tail)."""
+        ld = lat_edge * ans_scale
+        r = _frag_slice(retx_ms, frag_idx)
+        return ld if r is None else ld + r
+
+    def _gossip_jobs(t_rx, frag_idx):
+        """Shared job builder of the serialized answer model: per sampled
+        (round h, slot i) job, its IWANT arrival W = announce departure +
+        2 link traversals, and whether it is REQUESTED — the receiver
+        still lacks the message when that round's IHAVE lands (a lossy
+        edge loses the IHAVE with the copy: one survive draw per
+        fragment-edge, so no IWANT ever comes back on it)."""
+        base = t_rx + params.proc_delay_ms
+        tick = _next_heartbeat(base, hb_phase, params.heartbeat_ms)  # (N,)
+        live = can_send & (t_rx < INF)
+        sv = _frag_slice(survive, frag_idx)
+        q_t = t_rx[jnp.clip(conns, 0)]           # (N, C) receiver times
+        Ws, reqs = [], []
+        for h in range(n_rounds):
+            a_h = jnp.maximum(
+                tick + h * params.heartbeat_ms, uplink)[:, None]
+            samp = g_tgt_w[h] & live[:, None]
+            Ws.append(jnp.where(samp, a_h + 2.0 * lat_edge, INF))
+            r_h = samp & (q_t > a_h + lat_edge)
+            if sv is not None:
+                r_h = r_h & sv
+            reqs.append(r_h)
+        Wf = jnp.concatenate(Ws, axis=-1)        # (N, H*C), col = h*C + i
+        rf = jnp.concatenate(reqs, axis=-1)
+        return Wf, rf
+
+    def _offers_from_serve(serve_u, frag_idx):
+        """Per-edge delivery offer from per-job serve starts: + one tx
+        serialization + the answer's cold-flight delivery latency; min
+        over the edge's sampled rounds."""
+        lda = _ld_ans(frag_idx)
+        serve_hni = serve_u.reshape(n, n_rounds, c)
+        g_abs = jnp.min(
+            serve_hni + tx_ms[:, None, None] + lda[:, None, :], axis=1)
+        # overflowed INF+finite arithmetic back to the sentinel
+        return jnp.where(g_abs < INF, g_abs, INF)
+
+    def gossip_light(t_rx, frag_idx):
+        """No-queue gossip-answer offers + the SOUNDNESS HINT.
+
+        Valid exactly when no answer server ever holds two requested jobs
+        — then every answer starts at its own IWANT arrival (serve = W)
+        and the serialized model coincides with the unserialized one.
+        `hint` is the sound overapproximation of that condition: any
+        sender with >= 2 requested jobs across all rounds. hint=False
+        PROVES the fast path exact (one job can never wait behind
+        itself); hint=True only routes to the exact serialized branch.
+        Contains no lax.cond and no sort, so it is safe (and cheap) under
+        the fragment vmap — a batched lax.cond would lower to select_n
+        and execute BOTH branches (the r5 review catch).
+
+        Returns (g_abs, req_any, drain, hint)."""
+        Wf, rf = _gossip_jobs(t_rx, frag_idx)
+        req_any = rf.reshape(n, n_rounds, c).any(axis=1)
+        g_abs = _offers_from_serve(Wf, frag_idx)
+        # with <= 1 requested job per server, that job's serve end IS the
+        # drain: max over requested jobs of W + tx (0 when none)
+        drain = jnp.where(rf, Wf + tx_ms[:, None], 0.0).max(axis=-1)
+        hint = jnp.any(rf.sum(axis=-1) >= 2)
+        return g_abs, req_any, drain, hint
+
+    def gossip_serial_exact(t_rx, frag_idx):
+        """Exact serialized gossip-answer offers at the estimate t_rx.
+
+        A peer answering several IWANTs serializes the answers on its
+        uplink — the reference's per-connection queues all feed the
+        host's single host_bandwidth_up under Shadow (main.nim:264-299,
+        shadow/topogen.py:50-51) — so the answers form a single-server
+        queue in IWANT-arrival order (ties broken by (round, slot),
+        matching the DES heap). Only requested jobs occupy the queue, but
+        every sampled edge gets an offer = the time its answer WOULD
+        arrive if requested (self-consistent: an offer can only bind for
+        a receiver that was still lacking, i.e. requesting).
+
+        Single-server queue fold in global W order (rounds chain
+        naturally: a round's backlog spills into the next through the
+        running busy time). For sorted arrivals the busy time after
+        position j is B_j = M_j + R_j*tx with R the requested prefix
+        count and M_j = cummax(W - (R-1)*tx over requested prefix); the
+        job at position j starts at max(W_j, B_{j-1}).
+
+        Returns (g_abs, req_any, drain). Runs the sorts unconditionally —
+        callers reach it only on the hint-gated slow branch."""
+        Wf, rf_b = _gossip_jobs(t_rx, frag_idx)
+        req_any = rf_b.reshape(n, n_rounds, c).any(axis=1)
+        rf = rf_b.astype(jnp.float32)
+        txp = tx_ms[:, None]
+        perm = jnp.argsort(Wf, axis=-1, stable=True)
+        ws = jnp.take_along_axis(Wf, perm, axis=-1)
+        rs = jnp.take_along_axis(rf, perm, axis=-1)
+        R = jnp.cumsum(rs, axis=-1)
+        m_term = jnp.where(rs > 0.0, ws - (R - 1.0) * txp, -INF)
+        M = jax.lax.cummax(m_term, axis=m_term.ndim - 1)
+        M_prev = jnp.concatenate(
+            [jnp.full_like(M[:, :1], -INF), M[:, :-1]], axis=-1)
+        R_prev = jnp.concatenate(
+            [jnp.zeros_like(R[:, :1]), R[:, :-1]], axis=-1)
+        serve = jnp.maximum(ws, M_prev + R_prev * txp)
+        inv = jnp.argsort(perm, axis=-1, stable=True)
+        serve_u = jnp.take_along_axis(serve, inv, axis=-1)
+        drain = jnp.where(
+            R[:, -1] > 0.0, M[:, -1] + R[:, -1] * tx_ms, 0.0)
+        return _offers_from_serve(serve_u, frag_idx), req_any, drain
+
+    def offers(t_rx, rank, k_p, frag_idx, send_mask, deliver_only=False,
+               g_abs=None):
         """Arrival-time offers made by every peer on every neighbor slot.
         `deliver_only`: additionally mask copies the network loses — use for
         anything receiver-side (first-sender detection, delivery pulls);
-        leave False for transmit-side accounting (sends, tx bytes)."""
+        leave False for transmit-side accounting (sends, tx bytes).
+        `g_abs`: the serialized gossip-answer offers of gossip_serial
+        evaluated at the SAME t_rx (required when with_gossip)."""
         base = t_rx + params.proc_delay_ms
         start = jnp.maximum(base, uplink)
-        ld = _frag_slice(lat_deliver, frag_idx)
+        ld = _ld_mesh(frag_idx)
         # uplink serialization: (rank+1) sends of this fragment, plus the
         # frag_idx earlier fragments each occupying k_p uplink slots
         queue = (rank + 1.0 + frag_idx * k_p[:, None]) * tx_ms[:, None]
         cand = start[:, None] + queue + ld
         live = can_send[:, None] & (t_rx[:, None] < INF)
         sm = send_mask
-        gm = g_tgt
         if deliver_only and survive is not None:
             sv = _frag_slice(survive, frag_idx)
             sm = sm & sv
-            gm = gm & sv
         cand = jnp.where(sm & live, cand, INF)
         if with_gossip:
-            hb = _next_heartbeat(base, hb_phase, params.heartbeat_ms)
-            g = jnp.maximum(hb[:, None] + g_off, uplink[:, None]) \
-                + 2.0 * lat_edge + ld + tx_ms[:, None]
-            cand = jnp.minimum(cand, jnp.where(gm & live, g, INF))
+            ga = g_abs
+            if deliver_only and survive is not None:
+                ga = jnp.where(sv, ga, INF)
+            cand = jnp.minimum(cand, ga)
         return cand
 
     def pull(cand):
@@ -485,18 +675,21 @@ def disseminate(
         fragment multiplicity."""
         return reciprocal_pull_min(cand, conns, rev, batch_factor=fragments)
 
-    def converge(rank, k_p, frag_idx, t_pub, send_mask, t_init=None):
-        """`t_init`: optional warm start. Any pointwise upper bound on the
-        true arrival times converges to the same unique fixpoint (Bellman-
-        Ford from above, non-negative edge costs), in far fewer iterations
-        when the bound is close."""
+    def _converge_dyn(rank, k_p, frag_idx, t_pub, send_mask, t_init=None):
+        """UNSERIALIZED fixpoint (every gossip answer rides its own uplink
+        slot — exact whenever no answer queue forms; converge() below
+        detects and repairs the rare serialized case). `t_init`: optional
+        warm start. Any pointwise upper bound on the true arrival times
+        converges to the same unique fixpoint (Bellman-Ford from above,
+        non-negative edge costs), in far fewer iterations when the bound
+        is close."""
         t0 = (jnp.full((n,), INF) if t_init is None else t_init
               ).at[publisher].set(t_pub)
         # arrival times are about DELIVERY: lost copies never relax an edge
         # (their queue slots still count — rank/k_p came from the unmasked
         # send set)
         sv = _frag_slice(survive, frag_idx)
-        ld = _frag_slice(lat_deliver, frag_idx)
+        ld = _ld_mesh(frag_idx)
         deliver = send_mask if sv is None else send_mask & sv
         g_deliver = g_tgt if sv is None else g_tgt & sv
         if mesh is not None:
@@ -506,7 +699,7 @@ def disseminate(
                 conns, rev, lat_edge, tx_ms, rank, k_p, frag_idx, deliver,
                 can_send, g_deliver, g_off, hb_phase, uplink, rx_const,
                 params.proc_delay_ms, params.heartbeat_ms, with_gossip,
-                retx_ms=_frag_slice(retx_ms, frag_idx),
+                lat_deliver=ld, ld_gossip=_ld_ans(frag_idx),
             )
             return converge_sharded(t0, c, params.max_relax_iters, mesh)
         if exceeds_budget(jnp.float32, conns.shape, fragments):
@@ -521,7 +714,7 @@ def disseminate(
                 conns, rev, lat_edge, tx_ms, rank, k_p, frag_idx, deliver,
                 can_send, g_deliver, g_off, hb_phase, uplink, rx_const,
                 params.proc_delay_ms, params.heartbeat_ms, with_gossip,
-                retx_ms=_frag_slice(retx_ms, frag_idx),
+                lat_deliver=ld, ld_gossip=_ld_ans(frag_idx),
             )
             return converge_recv(t0, c, params.max_relax_iters)
         # single device below the budget: sender-major offers (loop-invariant
@@ -532,7 +725,7 @@ def disseminate(
             deliver & can_send[:, None], queue + ld, INF)
         g_base = jnp.where(
             g_deliver & can_send[:, None],
-            2.0 * lat_edge + ld + tx_ms[:, None], INF)
+            2.0 * lat_edge + _ld_ans(frag_idx) + tx_ms[:, None], INF)
 
         def cond(carry):
             _, changed, it = carry
@@ -564,6 +757,96 @@ def disseminate(
         t_rx, _, _ = jax.lax.while_loop(cond, body, (t0, jnp.bool_(True), 0))
         return t_rx
 
+    def _converge_floor(rank, k_p, frag_idx, t_pub, send_mask, g_floor,
+                        t_init):
+        """Mesh-only fixpoint against a FROZEN per-receiver gossip floor
+        (the serialized answer offers of one outer pass, already pulled to
+        the receiver side and row-minimized). Same three path dispatches as
+        _converge_dyn, with the gossip arithmetic out of the loop body."""
+        t0 = t_init.at[publisher].set(t_pub)
+        sv = _frag_slice(survive, frag_idx)
+        ld = _ld_mesh(frag_idx)
+        deliver = send_mask if sv is None else send_mask & sv
+        if mesh is not None or exceeds_budget(jnp.float32, conns.shape,
+                                              fragments):
+            c = build_recv_constants(
+                conns, rev, lat_edge, tx_ms, rank, k_p, frag_idx, deliver,
+                can_send, g_tgt, g_off, hb_phase, uplink, rx_const,
+                params.proc_delay_ms, params.heartbeat_ms, False,
+                lat_deliver=ld,
+            )
+            if mesh is not None:
+                return converge_sharded(t0, c, params.max_relax_iters, mesh,
+                                        g_floor=g_floor)
+            return converge_recv(t0, c, params.max_relax_iters,
+                                 g_floor=g_floor)
+        queue = (rank + 1.0 + frag_idx * k_p[:, None]) * tx_ms[:, None]
+        a_base = jnp.where(
+            deliver & can_send[:, None], queue + ld, INF)
+
+        def cond(carry):
+            _, changed, it = carry
+            return changed & (it < params.max_relax_iters)
+
+        def body(carry):
+            t_rx, _, it = carry
+            live = (t_rx < INF)[:, None]
+            start = jnp.maximum(t_rx + params.proc_delay_ms, uplink)
+            cand = jnp.where(live, start[:, None] + a_base, INF)
+            t_new = jnp.minimum(
+                t_rx,
+                jnp.maximum(
+                    jnp.minimum(pull(cand).min(axis=-1), g_floor), rx_const))
+            return t_new, jnp.any(t_new < t_rx), it + 1
+
+        t_rx, _, _ = jax.lax.while_loop(cond, body, (t0, jnp.bool_(True), 0))
+        return t_rx
+
+    def _converge_serialized(rank, k_p, frag_idx, t_pub, send_mask,
+                             t_seed=None):
+        """Exact fixpoint of the SERIALIZED answer model, as an outer
+        iteration on the gossip ESTIMATE: each pass freezes the serialized
+        answer offers at the current estimate t_g, then re-relaxes the
+        whole network FROM SCRATCH against that floor. The from-INF
+        restart is load-bearing (r5 review catch): the serialized system
+        is NOT monotone in t — raising an announcer's estimate delays its
+        IHAVE, which can REMOVE a requested job and make other answers
+        earlier — so a warm-started min-only relaxation could undershoot
+        and stick. A from-INF pass instead always lands exactly on
+        min(candidates | frozen g), so when a pass reproduces its own
+        estimate (t_new == t_g) the result is SELF-CONSISTENT:
+        t = min(candidates(t)) with every gossip term evaluated at t.
+        Any self-consistent point equals the DES's chronological fixpoint
+        — a hypothetically-early solution would need its earliest wrong
+        peer's candidate to be justified by strictly-earlier inputs, which
+        are all correct by minimality, reproducing the true (later) value;
+        contradiction. `t_seed`: optional starting estimate for the gossip
+        terms (e.g. the phase-1 result), purely a convergence accelerator.
+        """
+        sv = _frag_slice(survive, frag_idx)
+
+        def cond(carry):
+            _, _, changed, it = carry
+            return changed & (it < params.max_relax_iters)
+
+        def body(carry):
+            t_g, _, _, it = carry
+            g_abs, _, _ = gossip_serial_exact(t_g, frag_idx)
+            g_d = g_abs if sv is None else jnp.where(sv, g_abs, INF)
+            g_in = reciprocal_pull_min(
+                g_d, conns, rev, batch_factor=fragments)
+            g_floor = g_in.min(axis=-1)
+            t_new = _converge_floor(
+                rank, k_p, frag_idx, t_pub, send_mask, g_floor,
+                jnp.full((n,), INF))
+            return t_new, t_new, jnp.any(t_new != t_g), it + 1
+
+        t0 = (jnp.full((n,), INF) if t_seed is None else t_seed
+              ).at[publisher].set(t_pub)
+        _, t, _, _ = jax.lax.while_loop(
+            cond, body, (t0, t0, jnp.bool_(True), 0))
+        return t
+
     def queue_drop(tgt_mask, frag_idx):
         """Priority-queue drop model (main.nim:264-299). The reference's
         queues are per-CONNECTION and hold MESSAGES: the publisher enqueues
@@ -581,17 +864,15 @@ def disseminate(
         dropped = frag_idx + 1.0 > params.send_queue_cap
         return tgt_mask & ~(is_pub & dropped)
 
-    def one_fragment(frag_idx, t_pub):
-        tgt_f = queue_drop(tgt, frag_idx)
-        rank1 = _ranks_f32(jnp.where(tgt_f, rprio, INF))
-        k1 = tgt_f.sum(axis=-1).astype(jnp.float32)
-        t1 = converge(rank1, k1, frag_idx, t_pub, tgt_f)
-        if not params.exclude_first_sender:
-            return t1, rank1, k1, tgt_f
-        # phase 2: drop each peer's back-edge to its first sender from the
-        # send order and re-run — the slot is simply never occupied. The
-        # first sender is whoever DELIVERED (lost copies can't be it)
-        inc1 = pull(offers(t1, rank1, k1, frag_idx, tgt_f, deliver_only=True))
+    def _phase2_masks(t1, rank1, k1, tgt_f, frag_idx, g_abs1_del):
+        """Back-edge removal: drop each peer's slot toward its first sender
+        from the send order — the slot is simply never occupied. The first
+        sender is whoever DELIVERED (lost copies can't be it, and only
+        REQUESTED gossip answers were ever transmitted — the unanswered
+        edges' hypothetical offers never bind and must not steal the
+        attribution argmin; `g_abs1_del` comes pre-masked by the caller)."""
+        inc1 = pull(offers(t1, rank1, k1, frag_idx, tgt_f, deliver_only=True,
+                           g_abs=g_abs1_del))
         first_slot = jnp.argmin(inc1, axis=-1)
         # the min offer equals t1 BY CONSTRUCTION at the fixpoint (every
         # reached non-publisher peer's time IS some offer), but offers() and
@@ -621,21 +902,95 @@ def disseminate(
                            rank1, first_slot[:, None], axis=-1)[:, 0], INF)
         rank2 = rank1 - (rank1 > r0[:, None])
         k2 = k1 - rm.astype(jnp.float32)
+        return rank2, k2, send_mask
+
+    def phases_fast(frag_idx, t_pub):
+        """UNSERIALIZED two-phase pipeline + the gossip accounting triple
+        at the final times + the soundness hint. Exact whenever the hint
+        comes back False (see gossip_light); contains no lax.cond, so it
+        is safe under the fragment vmap. The hint is evaluated at BOTH
+        phase results and OR-ed (r5 review catch: requested sets are not
+        monotone in t — phase 2's earlier announce ticks can CREATE
+        contention phase 1 didn't have — so hint(t1) alone certifies only
+        the first-sender step, hint(t2) certifies the final times).
+        Returns (t2, rank2, k2, send_mask, g_abs, req_any, drain, hint)."""
+        tgt_f = queue_drop(tgt, frag_idx)
+        rank1 = _ranks_f32(jnp.where(tgt_f, rprio, INF))
+        k1 = tgt_f.sum(axis=-1).astype(jnp.float32)
+        t1 = _converge_dyn(rank1, k1, frag_idx, t_pub, tgt_f)
+        if with_gossip:
+            g1, req1, _, hint1 = gossip_light(t1, frag_idx)
+            ga1 = jnp.where(req1, g1, INF)
+        else:
+            ga1, hint1 = None, jnp.bool_(False)
+        if not params.exclude_first_sender:
+            g2, req2, drain2, hint2 = _acct_triple_light(t1, frag_idx)
+            return t1, rank1, k1, tgt_f, g2, req2, drain2, hint1 | hint2
+        rank2, k2, send_mask = _phase2_masks(
+            t1, rank1, k1, tgt_f, frag_idx, ga1)
         # phase-2 costs are pointwise <= phase-1 (a send slot was removed
         # from every queue), so t1 is a valid warm start
-        t2 = converge(rank2, k2, frag_idx, t_pub, send_mask, t_init=t1)
-        return t2, rank2, k2, send_mask
+        t2 = _converge_dyn(rank2, k2, frag_idx, t_pub, send_mask, t_init=t1)
+        g2, req2, drain2, hint2 = _acct_triple_light(t2, frag_idx)
+        return t2, rank2, k2, send_mask, g2, req2, drain2, hint1 | hint2
+
+    def _acct_triple_light(t, frag_idx):
+        if not with_gossip:
+            z = jnp.zeros((n, c), jnp.float32)
+            return (z, jnp.zeros((n, c), bool),
+                    jnp.zeros((n,), jnp.float32), jnp.bool_(False))
+        return gossip_light(t, frag_idx)
+
+    def phases_serial(frag_idx, t_pub):
+        """SERIALIZED pipeline: exact answer queues in both phases and in
+        the accounting triple. Reached only from the hint-gated slow
+        branch (a scalar-predicate lax.cond at message level — a real XLA
+        branch, never a batched select), so its sorts and outer passes
+        cost nothing when no answer ever queues."""
+        tgt_f = queue_drop(tgt, frag_idx)
+        rank1 = _ranks_f32(jnp.where(tgt_f, rprio, INF))
+        k1 = tgt_f.sum(axis=-1).astype(jnp.float32)
+        t1 = _converge_serialized(rank1, k1, frag_idx, t_pub, tgt_f)
+        if not params.exclude_first_sender:
+            g2, req2, drain2 = gossip_serial_exact(t1, frag_idx)
+            return t1, rank1, k1, tgt_f, g2, req2, drain2
+        g1, req1, _ = gossip_serial_exact(t1, frag_idx)
+        rank2, k2, send_mask = _phase2_masks(
+            t1, rank1, k1, tgt_f, frag_idx, jnp.where(req1, g1, INF))
+        t2 = _converge_serialized(rank2, k2, frag_idx, t_pub, send_mask,
+                                  t_seed=t1)
+        g2, req2, drain2 = gossip_serial_exact(t2, frag_idx)
+        return t2, rank2, k2, send_mask, g2, req2, drain2
 
     # publisher emits fragments back-to-back (main.nim:177-179)
     frag_ids = jnp.arange(fragments, dtype=jnp.float32)
     t_pubs = t0_ms + frag_ids * tx_ms[publisher]
     if mesh is None:
-        t_rx_f, rank_f, k_f, smask_f = jax.vmap(one_fragment)(frag_ids, t_pubs)
+        fast = jax.vmap(phases_fast)(frag_ids, t_pubs)
     else:
         # shard_map doesn't nest under vmap; fragments is static and <= 9
         # (topogen -f choices), so unroll the fragment axis instead
-        outs = [one_fragment(frag_ids[i], t_pubs[i]) for i in range(fragments)]
-        t_rx_f, rank_f, k_f, smask_f = (jnp.stack(x) for x in zip(*outs))
+        outs = [phases_fast(frag_ids[i], t_pubs[i])
+                for i in range(fragments)]
+        fast = tuple(jnp.stack(x) for x in zip(*outs))
+    fast_results, hint_f = fast[:7], fast[7]
+    if with_gossip:
+        # serialized-answer repair, decided ONCE per message on a SCALAR
+        # predicate: hint_f=False proves the unserialized pipeline exact
+        # (no answer server ever held two requested jobs, so nothing could
+        # wait — by uniqueness the unserialized fixpoint IS the serialized
+        # one); hint_f=True reruns the exact serialized pipeline. The
+        # scalar cond is a real branch on TPU — a vmapped cond would
+        # lower to select_n and execute both branches every publish.
+        def _slow(_):
+            outs = [phases_serial(frag_ids[i], t_pubs[i])
+                    for i in range(fragments)]
+            return tuple(jnp.stack(x) for x in zip(*outs))
+
+        fast_results = jax.lax.cond(
+            jnp.any(hint_f), _slow, lambda _: fast_results, operand=None)
+    (t_rx_f, rank_f, k_f, smask_f, g_abs_acct, req_acct,
+     drain_acct) = fast_results
 
     received = jnp.all(t_rx_f < INF, axis=0)
     t_rx = jnp.where(received, t_rx_f.max(axis=0), INF)  # last fragment completes
@@ -643,14 +998,21 @@ def disseminate(
 
 
     # ---- post-fixpoint accounting (bytes, duplicates, gossip, score) -------
-    def frag_accounting(frag_idx, t_rx_one, rank, k_p, send_mask):
-        sv = _frag_slice(survive, frag_idx)   # this fragment's loss draw
+    def frag_accounting(frag_idx, t_rx_one, rank, k_p, send_mask,
+                        g_abs_f, req_any_f, drain_f):
+        # this fragment's loss draw; the gossip triple (answer offers,
+        # answered sets, serialized queue drain) was resolved at the final
+        # times by the phase pipeline — light or exact per the hint branch
+        sv = _frag_slice(survive, frag_idx)
+        if not with_gossip:
+            g_abs_f = None
         # tx side (sends, bytes): everything transmitted, lost or not
-        cand = offers(t_rx_one, rank, k_p, frag_idx, send_mask)
+        cand = offers(t_rx_one, rank, k_p, frag_idx, send_mask,
+                      g_abs=g_abs_f)
         made_offer = cand < INF
         # rx side (first-delivery attribution): delivered copies only
         inc = pull(offers(t_rx_one, rank, k_p, frag_idx, send_mask,
-                          deliver_only=True))
+                          deliver_only=True, g_abs=g_abs_f))
         first_slot = jnp.argmin(inc, axis=-1)
         q_t = neighbor_pull_min(  # neighbor arrival times (fragment-vmapped)
             t_rx_one, conns, rev, batch_factor=fragments)
@@ -674,47 +1036,21 @@ def disseminate(
             start_tx + (frag_idx * k_p + last_pos) * tx_ms, 0.0)
         if with_gossip:
             havers = (t_rx_one < INF) & can_send
-            hb = _next_heartbeat(
-                t_rx_one + params.proc_delay_ms, hb_phase, params.heartbeat_ms
-            )
             # per-round accounting over the mcache window: every heartbeat
-            # tick h the emitter IHAVEs its fresh sample; the receiver IWANTs
-            # only if it still lacks the message when the announce lands.
-            # `lacked` fill on invalid slots is irrelevant: it is ANDed with
-            # per-round sets that are subsets of valid edges.
+            # tick h the emitter IHAVEs its fresh sample; the receiver
+            # IWANTs only if it still lacks the message when the announce
+            # lands — gossip_serial already resolved the answered sets
+            # (req_any_f) and the serialized drain of each peer's answer
+            # queue (drain_f: announce tick, IWANT round trip, then the
+            # answers transmitted BACK-TO-BACK on the answering uplink in
+            # IWANT-arrival order — sum, not max; rounds chain through the
+            # running busy time). The DES recomputes both through its
+            # chronological event heap.
             ihave_ct = jnp.zeros((n, c), jnp.float32)   # per-edge IHAVEs
-            gossip_sent = jnp.zeros((n, c), bool)       # edge answered an IWANT
-            best_h = jnp.zeros((n, c), jnp.float32)     # last answered round
             for h in range(n_rounds):
-                active_h = g_tgt_w[h] & havers[:, None]
-                ihave_ct = ihave_ct + active_h
-                # the announce leaves when the tick fires AND the sender's
-                # uplink has drained — same clamp the fixpoint applies
-                ans_start_h = jnp.maximum(
-                    hb[:, None] + h * params.heartbeat_ms, uplink[:, None])
-                ans_h = active_h & (q_t > ans_start_h + lat_edge)
-                if sv is not None:
-                    # a graylisted/lossy edge never delivers the IHAVE, so no
-                    # IWANT comes back and no answer is transmitted — the
-                    # control/byte accounting matches the fixpoint's
-                    # g_deliver = g_tgt & survive delivery gating
-                    ans_h = ans_h & sv
-                gossip_sent = gossip_sent | ans_h
-                best_h = jnp.where(ans_h, jnp.float32(h), best_h)
-            # answered IWANTs serialize on the answering uplink: IHAVE out at
-            # the tick, IWANT back (2 link traversals), then tx. The answer
-            # end grows with the round, so the drain is set by the LAST
-            # answered round (best_h) — one fused pass instead of one per
-            # round. Same-round answers take the MAX end, not the sum: an
-            # approximation (see module docstring) the DES mirrors exactly.
-            up_end = jnp.maximum(
-                up_end,
-                jnp.where(
-                    gossip_sent & made_offer,
-                    jnp.maximum(hb[:, None] + best_h * params.heartbeat_ms,
-                                uplink[:, None])
-                    + 2.0 * lat_edge + tx_ms[:, None],
-                    0.0).max(axis=-1))
+                ihave_ct = ihave_ct + (g_tgt_w[h] & havers[:, None])
+            gossip_sent = req_any_f                     # edge answered >=1 IWANT
+            up_end = jnp.maximum(up_end, drain_f)
             ihave_pp = ihave_ct.sum(axis=-1)            # (N,) IHAVEs sent
             # the IWANT flows opposite the IHAVE: the lacking RECEIVER sends
             # it, the gossiping peer receives it
@@ -722,6 +1058,8 @@ def disseminate(
             sends = sends + (gossip_sent & made_offer).sum(axis=-1)
             sent_any = eff_send | (gossip_sent & made_offer)
             arrived = sent_any if sv is None else sent_any & sv
+            lost_pp = (jnp.zeros((n,), jnp.float32) if sv is None
+                       else (sent_any & ~sv).sum(axis=-1).astype(jnp.float32))
             # ONE pull for all three involution-crossing quantities: the
             # per-edge IHAVE count (<= history_gossip), the IWANT flag and
             # the delivered-copy flag pack exactly into one small float —
@@ -749,6 +1087,8 @@ def disseminate(
             sent_any = eff_send
             # receivers only count copies the network actually delivered
             arrived = sent_any if sv is None else sent_any & sv
+            lost_pp = (jnp.zeros((n,), jnp.float32) if sv is None
+                       else (sent_any & ~sv).sum(axis=-1).astype(jnp.float32))
             arrived_rx = reciprocal_pull_bool(
                 arrived, conns, rev, batch_factor=fragments)
             copies = arrived_rx.sum(axis=-1).astype(jnp.float32)
@@ -774,13 +1114,15 @@ def disseminate(
         else:
             slow_inc = jnp.zeros((n, c), jnp.float32)
         return (sends, copies, ihave_pp, iwant_pp, ihave_rx_pp, iwant_rx_pp,
-                first_slot, slow_inc, arr_t, up_end)
+                first_slot, slow_inc, arr_t, up_end, lost_pp)
 
     (sends_f, copies_f, ihave_f, iwant_f, ihave_rx_f, iwant_rx_f,
-     first_slot_f, slow_f, arr_f, up_end_f) = jax.vmap(
+     first_slot_f, slow_f, arr_f, up_end_f, lost_f) = jax.vmap(
         frag_accounting
-    )(frag_ids, t_rx_f, rank_f, k_f, smask_f)
+    )(frag_ids, t_rx_f, rank_f, k_f, smask_f, g_abs_acct, req_acct,
+      drain_acct)
     sends = sends_f.sum(axis=0).astype(jnp.int32)
+    lost_tx = lost_f.sum(axis=0).astype(jnp.int32)
     copies = copies_f.sum(axis=0).astype(jnp.int32)
     ihave_pp = ihave_f.sum(axis=0).astype(jnp.int32)
     iwant_pp = iwant_f.sum(axis=0).astype(jnp.int32)
@@ -819,6 +1161,7 @@ def disseminate(
         copies_rx=copies,
         ihave_sent=ihave_pp,
         iwant_sent=iwant_pp,
+        lost_tx=lost_tx,
     )
     dup = jnp.maximum(copies - fragments, 0)
     # uplink occupancy write-back: per fragment, frag_accounting computed the

@@ -252,7 +252,10 @@ def lookup(
         in `unique_peers` (core.nim:40-44's HashSet over ad.data.peerId);
       - a lookup whose accumulated wall time exceeds
         `lookup_deadline_ms` FAILS: counts are zeroed and `ok` is False,
-        the valueOr branch the reference logs as "Lookup failed".
+        the valueOr branch the reference logs as "Lookup failed" — and the
+        walk ABORTS there (r4 advisor): waves past the deadline never
+        start, so a failed lookup stops generating queries, learning and
+        traffic the way runLookupLoop's deadline abort does.
     """
     n = kstate.rtable.shape[0]
     q = discoverers.shape[0]
@@ -280,6 +283,13 @@ def lookup(
         cand = (sl >= 0) & ~queried & (sl != discoverers[:, None])
         head_unqueried = (cand & (rank < kad.K_RESP)).any(axis=-1)
         cand = cand & head_unqueried[:, None]
+        # deadline abort (r4 advisor): runLookupLoop stops AT the deadline,
+        # so a wave starting past the budget never happens — no queries, no
+        # routing-table learning, no traffic counters. Granularity is the
+        # wave: the wave that CROSSES the deadline completes (its requests
+        # were already in flight when the timer fired), later waves don't
+        # start.
+        cand = cand & (t_acc < params.lookup_deadline_ms)[:, None]
         pick, p_ids = kad._pick_alpha(sl, rank, cand, s)
         any_pick = pick.any(axis=-1)
         p_live = (p_ids >= 0) & kstate.alive[jnp.clip(p_ids, 0)]

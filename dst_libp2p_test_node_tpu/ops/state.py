@@ -59,6 +59,18 @@ class SimParams:
     publish_threshold: float = -1000.0   # flood/fanout skips peers below
     graylist_threshold: float = -10000.0  # receiver ignores peers below
     proc_delay_ms: float = 2.0  # per-hop validation/processing latency
+    # TCP slow-start transfer dynamics (ops/disseminate.py tcp_flights):
+    # under Shadow the nodes run REAL TCP stacks
+    # (regression/Dockerfile_amd64_shadow:3-11), so a transfer larger than
+    # the initial congestion window needs multiple RTT-gated flights —
+    # the first flight carries at most initcwnd_segments * mss_bytes
+    # (Linux IW10, RFC 6928) and the window doubles each RTT. Messages are
+    # seconds apart, so every transfer starts from a slow-start-restarted
+    # (cold) window. slow_start=False removes the term (datagram-style
+    # transports with no window, and A/B isolation in tests).
+    slow_start: bool = True
+    mss_bytes: int = 1460
+    initcwnd_segments: int = 10
     fanout_ttl_ms: float = 60_000.0  # v1.1 fanoutTTL (libp2p default 60 s)
     max_relax_iters: int = 48   # bound on the earliest-arrival fixpoint
     exclude_first_sender: bool = True   # don't forward back to the delivering peer
@@ -79,6 +91,8 @@ class SimParams:
         if self.history_gossip < 1:
             raise ValueError(
                 f"history_gossip must be >= 1, got {self.history_gossip}")
+        if self.mss_bytes < 1 or self.initcwnd_segments < 1:
+            raise ValueError("mss_bytes and initcwnd_segments must be >= 1")
         # the spec requires non-positive thresholds; enforcing it keeps the
         # static can-thresholds-bind compile decision sound (scores are
         # non-negative unless a negative weight is configured)

@@ -90,25 +90,31 @@ def build_recv_constants(
     proc_ms: float,
     hb_ms: float,
     with_gossip: bool,
-    retx_ms=None,
+    lat_deliver=None,
+    ld_gossip=None,
 ) -> RecvConstants:
     """Gather every sender-side term of ops/disseminate.offers through the
     reverse-slot map once, leaving a fixpoint that touches only t_rx.
 
-    `retx_ms`: optional (N, C) per-edge TCP-retransmission stall of the
-    data-carrying traversal (ops/disseminate loss_mode="tcp") — an additive
-    edge constant, so it folds into a_ms/g_ms here and costs the fixpoint
-    nothing per iteration."""
+    `lat_deliver` / `ld_gossip`: optional (N, C) effective DELIVERY latency
+    of the data-carrying traversal for mesh sends / gossip answers — wire
+    latency scaled by the TCP slow-start flight count plus the sampled
+    retransmission stall (ops/disseminate loss_mode="tcp"). Additive edge
+    constants, so they fold into a_ms/g_ms here and cost the fixpoint
+    nothing per iteration. Default to the bare lat_edge."""
     valid = (conns >= 0) & (rev >= 0)
     queue = (rank + 1.0 + frag_idx * k_p[:, None]) * tx_ms[:, None]
-    lat_deliver = lat_edge if retx_ms is None else lat_edge + retx_ms
+    if lat_deliver is None:
+        lat_deliver = lat_edge
+    if ld_gossip is None:
+        ld_gossip = lat_deliver
     a_sender = queue + lat_deliver  # offers minus the send start
     a_ms = jnp.where(valid, _edge_gather(a_sender, conns, rev), INF)
     mesh_ok = valid & _edge_gather(
         send_mask & can_send[:, None], conns, rev)
 
     if with_gossip:
-        g_sender = 2.0 * lat_edge + lat_deliver + tx_ms[:, None]
+        g_sender = 2.0 * lat_edge + ld_gossip + tx_ms[:, None]
         g_ms = jnp.where(valid, _edge_gather(g_sender, conns, rev), INF)
         g_ok = valid & _edge_gather(g_tgt & can_send[:, None], conns, rev)
         g_off = _edge_gather(g_off_s, conns, rev)
@@ -150,9 +156,14 @@ def _inc_from(t_all: jnp.ndarray, c: RecvConstants) -> jnp.ndarray:
 
 
 def converge_recv(
-    t0: jnp.ndarray, c: RecvConstants, max_iters: int
+    t0: jnp.ndarray, c: RecvConstants, max_iters: int, g_floor=None
 ) -> jnp.ndarray:
-    """Single-shard receiver-side fixpoint (reference for the sharded one)."""
+    """Single-shard receiver-side fixpoint (reference for the sharded one).
+
+    `g_floor`: optional (N,) per-receiver FROZEN gossip candidate — the
+    serialized answer offers of one outer pass of the serialized-answer
+    model (ops/disseminate gossip_serial), already row-minimized. Receiver-
+    local, so it joins the row min at zero per-iteration cost."""
 
     def cond(carry):
         _, changed, it = carry
@@ -163,8 +174,10 @@ def converge_recv(
         # downlink clamp: delivery completes no earlier than the receiver's
         # downlink drains prior traffic plus this copy (max distributes over
         # the row min, so clamping the min equals clamping every candidate)
-        t_new = jnp.minimum(
-            t_rx, jnp.maximum(_inc_from(t_rx, c).min(axis=-1), c.rx_c))
+        inc_min = _inc_from(t_rx, c).min(axis=-1)
+        if g_floor is not None:
+            inc_min = jnp.minimum(inc_min, g_floor)
+        t_new = jnp.minimum(t_rx, jnp.maximum(inc_min, c.rx_c))
         return t_new, jnp.any(t_new < t_rx), it + 1
 
     t_rx, _, _ = jax.lax.while_loop(cond, body, (t0, jnp.bool_(True), 0))
@@ -172,15 +185,20 @@ def converge_recv(
 
 
 def converge_sharded(
-    t0: jnp.ndarray, c: RecvConstants, max_iters: int, mesh: Mesh
+    t0: jnp.ndarray, c: RecvConstants, max_iters: int, mesh: Mesh,
+    g_floor=None,
 ) -> jnp.ndarray:
     """shard_map fixpoint over the peer axis: rows of the constants live on
     their shard; each iteration all-gathers the (N,) time vector over ICI
-    and psums one convergence bit. Identical results to converge_recv."""
+    and psums one convergence bit. Identical results to converge_recv
+    (including the optional frozen `g_floor`, which shards with the rows)."""
     rows = P(PEER_AXIS)
+    use_floor = g_floor is not None
+    if g_floor is None:
+        g_floor = jnp.full_like(t0, INF)
 
     def local_fix(t0_l, src, a_ms, mesh_ok, g_ms, g_ok, g_off, phase, u_ms,
-                  rx_c):
+                  rx_c, gf_l):
         c_l = RecvConstants(
             src=src, a_ms=a_ms, mesh_ok=mesh_ok, g_ms=g_ms, g_ok=g_ok,
             g_off=g_off, phase=phase, u_ms=u_ms, rx_c=rx_c,
@@ -194,8 +212,10 @@ def converge_sharded(
         def body(carry):
             t_l, _, it = carry
             t_all = jax.lax.all_gather(t_l, PEER_AXIS, tiled=True)
-            t_new = jnp.minimum(
-                t_l, jnp.maximum(_inc_from(t_all, c_l).min(axis=-1), rx_c))
+            inc_min = _inc_from(t_all, c_l).min(axis=-1)
+            if use_floor:
+                inc_min = jnp.minimum(inc_min, gf_l)
+            t_new = jnp.minimum(t_l, jnp.maximum(inc_min, rx_c))
             changed = jax.lax.psum(
                 jnp.any(t_new < t_l).astype(jnp.int32), PEER_AXIS) > 0
             return t_new, changed, it + 1
@@ -206,11 +226,11 @@ def converge_sharded(
     fn = jax.shard_map(
         local_fix,
         mesh=mesh,
-        in_specs=(rows,) * 10,
+        in_specs=(rows,) * 11,
         out_specs=rows,
     )
     return fn(t0, c.src, c.a_ms, c.mesh_ok, c.g_ms, c.g_ok, c.g_off,
-              c.phase, c.u_ms, c.rx_c)
+              c.phase, c.u_ms, c.rx_c, g_floor)
 
 
 def place_sharded(mesh: Mesh, *arrays):

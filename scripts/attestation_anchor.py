@@ -1,0 +1,173 @@
+"""Second external validity anchor: Ethereum attestation-scale gossip
+(VERDICT r4 ask #4 — triangulate the single Ethereum block anchor with a
+second published operating point).
+
+The block anchor (scripts/eth_anchor.py) probes the LARGE-message regime,
+where the model's slow-start flight dynamics and uplink serialization
+dominate. This anchor probes the opposite end of that axis: a SMALL
+single-MTU message through the identical spec-specified gossip
+configuration. Together the two points constrain the model's size axis —
+a model that matched 128 KB blocks by accident (e.g. by over-charging
+per-hop cost while under-charging transfer dynamics) cannot also match
+the small-message point, where transfer terms vanish and per-hop
+latency + mesh depth are all that remain.
+
+Published reference points (named sources; stable public facts only —
+no numbers are invented here):
+
+  1. The gossip configuration is SPECIFIED and IDENTICAL to the block
+     anchor's: ethereum/consensus-specs phase0/p2p-interface.md fixes
+     D=8, D_low=6, D_high=12, D_lazy=6, heartbeat 700 ms,
+     mcache_gossip=3 for all gossip topics.
+  2. The message size is SPECIFIED: a phase0 unaggregated Attestation is
+     a few hundred bytes SSZ (an AttestationData of 128 bytes plus
+     aggregation bits, signature, and envelope — well under one MTU);
+     aggregates are similar. We run 600 bytes.
+  3. The timeline is SPECIFIED: attestations are produced 1/3 into the
+     slot and must reach aggregators before aggregates are broadcast at
+     2/3 into the slot (phase0/validator.md) — an effective ~4 s
+     network-wide dissemination window, same shape as the block deadline.
+  4. The measured behavior is PUBLISHED at the OUTCOME level: mainnet
+     attestation participation/inclusion consistently runs >= 99%
+     (beaconcha.in network statistics; client-team dashboards), which is
+     only possible if small-message gossip blankets the ~10^4-node
+     network well inside these windows, slot after slot.
+
+The anchor claims this script checks (and docs/VALIDITY.md records):
+
+  - coverage ~1.0 with >= 99.9% of deliveries inside the 4 s window —
+    the regime mainnet's >= 99% participation requires;
+  - p50 sits WELL BELOW the block anchor's p50: a 600 B message fits the
+    initial congestion window (1 flight, no serialization amplification),
+    so its latency is pure hop latency + processing — the model's size
+    axis must separate the two operating points in the right direction
+    and by a transfer-dynamics-sized margin (block p50 >= 1.5x ours).
+
+Run:  python scripts/attestation_anchor.py [--write docs/VALIDITY_ANCHOR2.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dst_libp2p_test_node_tpu.config.env import GossipSubParams  # noqa: E402
+from dst_libp2p_test_node_tpu.config.topology import TopoParams  # noqa: E402
+from dst_libp2p_test_node_tpu.runtime.simulator import (  # noqa: E402
+    ExperimentConfig, Simulator)
+
+N = 10_000
+ATT_BYTES = 600          # unaggregated attestation envelope, single MTU
+SLOTS = 5
+SLOT_MS = 12_000.0
+WINDOW_MS = 4_000.0      # produced at 1/3 slot, aggregated at 2/3 slot
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ARTIFACT = os.path.join(HERE, "docs", "VALIDITY_ANCHOR2.json")
+BLOCK_ARTIFACT = os.path.join(HERE, "docs", "VALIDITY_ANCHOR.json")
+PIN_TOL = 0.20
+
+
+def run() -> dict:
+    gs = GossipSubParams(
+        d=8, d_low=6, d_high=12, d_lazy=6,
+        heartbeat_ms=700,
+        history_gossip=3,
+        flood_publish=True,
+    )
+    topo = TopoParams(
+        network_size=N, anchor_stages=5,
+        min_bandwidth=50, max_bandwidth=150,
+        min_latency=20, max_latency=150,       # same WAN as the block anchor
+        msg_size_bytes=ATT_BYTES, messages=SLOTS,
+        delay_seconds=SLOT_MS / 1000.0,
+    )
+    cfg = ExperimentConfig(
+        topo=topo, connect_to=12, gossipsub=gs, warmup_s=60.0, seed=0,
+    )
+    sim = Simulator(cfg)
+    sim.warmup()
+    for i in range(SLOTS):
+        if i:
+            sim.advance(SLOT_MS)
+        sim.publish(4 + i)     # a different attester each slot
+    delays = np.concatenate([r.delays_ms for r in sim.records])
+    ok = np.isfinite(delays)
+    d = delays[ok]
+    return {
+        "coverage": round(float(ok.mean()), 4),
+        "p50_ms": round(float(np.percentile(d, 50)), 1),
+        "p90_ms": round(float(np.percentile(d, 90)), 1),
+        "p99_ms": round(float(np.percentile(d, 99)), 1),
+        "max_ms": round(float(d.max()), 1),
+        "within_window": round(float((d <= WINDOW_MS).mean()), 4),
+    }
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--write", metavar="PATH", default=None)
+    a = p.parse_args()
+    ours = run()
+
+    assert ours["coverage"] >= 0.999, ours
+    assert ours["within_window"] >= 0.999, ours
+    # single-flight small messages: hop latency + proc only — sub-second
+    assert ours["p50_ms"] <= 1000.0, ours
+    # the size axis must separate the two anchors in the right direction
+    # by a transfer-dynamics-sized margin
+    if os.path.exists(BLOCK_ARTIFACT):
+        with open(BLOCK_ARTIFACT) as f:
+            block_p50 = json.load(f)["ours"]["p50_ms"]
+        assert block_p50 >= 1.5 * ours["p50_ms"], (block_p50, ours)
+    # tripwire against the committed artifact (same discipline as the
+    # block anchor: drift must be a conscious regeneration)
+    if os.path.exists(ARTIFACT) and not a.write:
+        with open(ARTIFACT) as f:
+            committed = json.load(f)["ours"]["p50_ms"]
+        assert abs(ours["p50_ms"] - committed) <= PIN_TOL * committed, (
+            f"p50 {ours['p50_ms']} drifted beyond +-{PIN_TOL:.0%} of the "
+            f"committed anchor {committed}; regenerate with --write if the "
+            f"model legitimately changed")
+
+    out = {
+        "config": {
+            "peers": N, "msg_size_bytes": ATT_BYTES, "slots": SLOTS,
+            "slot_ms": SLOT_MS, "connect_to": 12,
+            "gossipsub": {"d": 8, "d_low": 6, "d_high": 12, "d_lazy": 6,
+                          "heartbeat_ms": 700, "mcache_gossip": 3},
+            "latency_ms": [20, 150], "bandwidth_mbit": [50, 150],
+            "seed": 0,
+        },
+        "published_anchor": {
+            "source_config": "ethereum/consensus-specs "
+                             "phase0/p2p-interface.md (gossip params; "
+                             "attestation SSZ sizes), phase0/validator.md "
+                             "(1/3-slot attestation, 2/3-slot aggregation "
+                             "timeline)",
+            "source_measurement": "mainnet attestation participation / "
+                                  "inclusion >= 99% (beaconcha.in network "
+                                  "statistics; client-team dashboards) — an "
+                                  "outcome only reachable if small-message "
+                                  "gossip blankets the network well inside "
+                                  "the ~4 s window every slot",
+            "window_ms": WINDOW_MS,
+            "network_size_order": 10_000,
+        },
+        "ours": ours,
+    }
+    print(json.dumps(out, indent=2))
+    if a.write:
+        with open(a.write, "w") as f:
+            json.dump(out, f, indent=2)
+            f.write("\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -102,6 +102,11 @@ def run() -> dict:
     }
 
 
+ARTIFACT = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "docs", "VALIDITY_ANCHOR.json")
+PIN_TOL = 0.20   # trips on a 1.25x model shift, well inside the r4 ask (1.5x)
+
+
 def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--write", metavar="PATH", default=None)
@@ -109,11 +114,23 @@ def main() -> None:
     ours = run()
 
     # the anchor claims (docs/VALIDITY.md): same order as the published
-    # mainnet band, below it (no production/validation in pure gossip),
-    # and the spec deadline met
+    # mainnet band (with slow-start flight dynamics the 128 KB block pays
+    # ~3 extra RTTs per hop, placing p50 near the band's lower edge rather
+    # than 2-4x below it), and the spec deadline met
     assert ours["coverage"] >= 0.999, ours
-    assert 200.0 <= ours["p50_ms"] <= 2000.0, ours
+    assert 400.0 <= ours["p50_ms"] <= 2000.0, ours
     assert ours["within_deadline"] >= 0.99, ours
+    # tripwire against the COMMITTED anchor (r4 weak #4: the wide corridor
+    # certified too little) — any model change that moves p50 beyond
+    # +-PIN_TOL of the committed value must consciously regenerate the
+    # artifact, not silently drift past an order-of-magnitude assert
+    if os.path.exists(ARTIFACT) and not a.write:
+        with open(ARTIFACT) as f:
+            committed = json.load(f)["ours"]["p50_ms"]
+        assert abs(ours["p50_ms"] - committed) <= PIN_TOL * committed, (
+            f"p50 {ours['p50_ms']} drifted beyond +-{PIN_TOL:.0%} of the "
+            f"committed anchor {committed}; regenerate with --write if the "
+            f"model legitimately changed")
 
     out = {
         "config": {

@@ -1,0 +1,120 @@
+"""Muxer-axis sensitivity table (VERDICT r4 ask #6).
+
+Round 4 measured the per-crossing anchor (EVENT_LOOP_MS = 0.2 ms,
+scripts/calibrate_event_loop.py) but the per-stack crossing COUNTS
+(yamux 4, mplex 4.4, quic 3 — runtime/simulator.py) remain a
+layer-composition argument. This script bounds what that uncertainty can
+possibly matter: it runs the Shadow-parity config-1 shape under all three
+muxers, plus a deliberately out-of-range crossing count (8 — double
+yamux's), and commits the p50/p99 spans into
+docs/event_loop_calibration.json.
+
+The point being demonstrated: per-hop processing cost enters delay as
+(hops x crossings x EVENT_LOOP_MS). At 0.2 ms/crossing and the ~3-5 mesh
+hops of a 100-peer network, the whole plausible crossing-count range moves
+p50 by single milliseconds against a ~0.5-1 s dissemination time — so the
+derived counts are a bounded modeling choice, not a load-bearing
+calibration. The table makes that bound a committed, tripwire-checkable
+number instead of an assertion.
+
+Run:  python scripts/muxer_sensitivity.py [--write docs/event_loop_calibration.json]
+(--write MERGES the table into the existing calibration artifact.)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dst_libp2p_test_node_tpu.config.topology import TopoParams  # noqa: E402
+from dst_libp2p_test_node_tpu.runtime.simulator import (  # noqa: E402
+    EVENT_LOOP_MS, MUXER_PROC_MS, ExperimentConfig, Simulator)
+
+N = 100
+MSG_SIZE = 15000
+MESSAGES = 5
+
+
+def _run(muxer: str, proc_ms_override=None) -> dict:
+    topo = TopoParams(
+        network_size=N, anchor_stages=5, min_bandwidth=50, max_bandwidth=150,
+        min_latency=40, max_latency=130, msg_size_bytes=MSG_SIZE,
+        messages=MESSAGES, delay_seconds=2.0, muxer=muxer,
+    )
+    cfg = ExperimentConfig(topo=topo, connect_to=10, warmup_s=60.0, seed=0)
+    sim = Simulator(cfg)
+    if proc_ms_override is not None:
+        import dataclasses
+
+        sim.params = dataclasses.replace(
+            sim.params, proc_delay_ms=proc_ms_override)
+    sim.warmup()
+    for i in range(MESSAGES):
+        if i:
+            sim.advance(2000.0)
+        sim.publish(4)
+    delays = np.concatenate([r.delays_ms for r in sim.records])
+    ok = np.isfinite(delays)
+    return {
+        "muxer": muxer,
+        "proc_ms": round(float(proc_ms_override
+                               if proc_ms_override is not None
+                               else MUXER_PROC_MS[muxer]), 3),
+        "coverage": round(float(ok.mean()), 4),
+        "p50_ms": round(float(np.percentile(delays[ok], 50)), 1),
+        "p99_ms": round(float(np.percentile(delays[ok], 99)), 1),
+    }
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--write", metavar="PATH", default=None)
+    a = p.parse_args()
+
+    rows = [
+        _run("quic"),            # 3 crossings
+        _run("yamux"),           # 4 crossings
+        _run("mplex"),           # 4.4 crossings
+        # out-of-range bound: double yamux's crossing count — if even THIS
+        # barely moves the statistics, no plausible miscount can matter
+        _run("yamux", proc_ms_override=8.0 * EVENT_LOOP_MS),
+    ]
+    rows[-1]["muxer"] = "bound_8_crossings"
+    in_range = rows[:3]
+    p50s = [r["p50_ms"] for r in in_range]
+    p99s = [r["p99_ms"] for r in in_range]
+    span = {
+        "p50_span_pct": round((max(p50s) - min(p50s)) / min(p50s) * 100, 2),
+        "p99_span_pct": round((max(p99s) - min(p99s)) / min(p99s) * 100, 2),
+        "p50_bound_shift_pct": round(
+            (rows[-1]["p50_ms"] - rows[1]["p50_ms"])
+            / rows[1]["p50_ms"] * 100, 2),
+    }
+    # the claim the table exists to certify: the whole muxer axis (and a
+    # doubled crossing count) moves the statistics by low single digits —
+    # the derived counts are a bounded modeling choice
+    assert span["p50_span_pct"] < 5.0, span
+    assert abs(span["p50_bound_shift_pct"]) < 5.0, span
+
+    table = {"runs": rows, "span": span,
+             "config": {"peers": N, "msg_size_bytes": MSG_SIZE,
+                        "messages": MESSAGES, "connect_to": 10, "seed": 0,
+                        "event_loop_ms": EVENT_LOOP_MS}}
+    print(json.dumps(table, indent=2))
+    if a.write:
+        with open(a.write) as f:
+            artifact = json.load(f)
+        artifact["muxer_sensitivity"] = table
+        with open(a.write, "w") as f:
+            json.dump(artifact, f, indent=2)
+            f.write("\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -41,8 +41,10 @@ def test_path_graph_exact_latency():
     )
     # single stage: L = self-loop latency = 100 ms; tx = 15000*8/50e6*1e3 = 2.4
     L, tx, proc = 100.0, 2.4, params.proc_delay_ms
-    # each intermediate hop forwards only onward (back-edge excluded -> rank 0)
-    hop = proc + tx + L
+    # each intermediate hop forwards only onward (back-edge excluded -> rank 0);
+    # 15 KB exceeds the ~14.6 KB initial window: 2 slow-start flights, so the
+    # data traversal costs L * (1 + 2*(flights-1)) = 3L
+    hop = proc + tx + 3.0 * L
     delays = np.asarray(res.delay_ms)
     expect = np.array([0.0] + [hop * h for h in range(1, n)])
     np.testing.assert_allclose(delays, expect, rtol=1e-5)
@@ -68,8 +70,46 @@ def test_star_uplink_serialization():
         with_gossip=False,
     )
     delays = np.sort(np.asarray(res.delay_ms)[1:])
-    expect = params.proc_delay_ms + 100.0 + 2.4 * np.arange(1, k + 1)
+    # 3*L: the 15 KB copy needs 2 slow-start flights (+1 RTT on the wire)
+    expect = params.proc_delay_ms + 300.0 + 2.4 * np.arange(1, k + 1)
     np.testing.assert_allclose(delays, expect, rtol=1e-5)
+
+
+def test_gossip_answer_serialization_exact():
+    # star: publisher 0 connected to 1..k; EMPTY mesh and no flood, so the
+    # only path is gossip round 0: every receiver lacks at the IHAVE, all k
+    # IWANT back, and the answers must serialize BACK-TO-BACK on 0's uplink
+    # (sum, not max): sorted delays = tick + 2L (control) + (i+1)*tx
+    # + 3L (answer data: 2 cold slow-start flights), i = 0..k-1.
+    n, k = 9, 8
+    g = build_connection_graph(
+        n, 1, seed=0,
+        dials=np.vstack([np.full((1, 1), 1),
+                         np.zeros((n - 1, 1), dtype=np.int64)]),
+        max_degree=n)
+    stage, lat, bw = single_stage_topo(n)
+    params = SimParams(n=n, capacity=g.capacity, d_lazy=16,
+                       flood_publish=False, max_relax_iters=16)
+    state = init_state(params, seed=3)
+    state = state.replace(
+        mesh_mask=jnp.zeros_like(state.mesh_mask),
+        hb_phase=jnp.full((n,), 250.0, jnp.float32),
+    )
+    res, s2 = disseminate(
+        state, jnp.asarray(g.conns), jnp.asarray(g.rev), stage, lat, bw,
+        publisher=0, t0_ms=0.0, params=params, payload_bytes=15000,
+        with_gossip=True,
+    )
+    assert bool(np.asarray(res.received).all())
+    delays = np.sort(np.asarray(res.delay_ms)[1:])
+    L, tx = 100.0, 2.4
+    expect = 250.0 + 2.0 * L + tx * np.arange(1, k + 1) + 3.0 * L
+    np.testing.assert_allclose(delays, expect, rtol=1e-5)
+    # one answered IWANT per receiver, all served by the publisher
+    assert int(np.asarray(res.iwant_sent).sum()) == k
+    # the uplink write-back carries the serialized drain: tick + 2L + k*tx
+    up = np.asarray(s2.uplink_free_ms)
+    np.testing.assert_allclose(up[0], 250.0 + 200.0 + k * tx, rtol=1e-5)
 
 
 def mesh_setup(*, n=100, connect_to=10, seed=0, hb=10, **over):
@@ -127,7 +167,8 @@ def test_full_coverage_100_peers():
     assert delays[4] == 0.0
     others = np.delete(delays, 4)
     assert (others > 0).all()
-    assert others.max() < 3000.0, others.max()  # sane for 40-130ms links
+    # sane for 40-130 ms links with +1 slow-start RTT per 15 KB data hop
+    assert others.max() < 4000.0, others.max()
     assert others.min() >= 40.0  # can't beat the fastest link latency
 
 
@@ -337,8 +378,9 @@ def test_persistent_phase_controls_gossip_timing():
     res1, s1 = disseminate(state, *args, publisher=0, t0_ms=0.0, params=params,
                            payload_bytes=15000, with_gossip=True)
     # analytic: gossip fires at 0's first tick after t0+proc (phase 250 ms),
-    # then IHAVE -> IWANT -> msg = 3 link traversals + one serialization
-    expect = 250.0 + 3 * 100.0 + 2.4
+    # then IHAVE -> IWANT (2 clean control traversals) -> the answering data
+    # send (one serialization + 2 cold slow-start flights = 3 traversals)
+    expect = 250.0 + (3 + 2) * 100.0 + 2.4
     np.testing.assert_allclose(float(res1.delay_ms[1]), expect, rtol=1e-5)
     # the phase is a run property: disseminate must not redraw it
     np.testing.assert_array_equal(

@@ -244,13 +244,20 @@ def test_lookup_deadline_fails_loudly():
     n = 64
     store, st, stage, lat, svc_keys, _ = _advertised_store(n, [7])
     params = SDParams(k_store=4, lookup_deadline_ms=1.0)
-    res, _ = lookup(store, st, jnp.asarray([3], jnp.int32),
-                    jnp.zeros((1,), jnp.int32), svc_keys, stage, lat,
-                    jnp.float32(1000.0), params)
+    res, k2 = lookup(store, st, jnp.asarray([3], jnp.int32),
+                     jnp.zeros((1,), jnp.int32), svc_keys, stage, lat,
+                     jnp.float32(1000.0), params)
     assert not bool(res.ok[0])
     assert int(res.unique_peers[0]) == 0
     assert int(res.advertisements[0]) == 0
     assert float(res.latency_ms[0]) > 1.0
+    # the walk ABORTS at the deadline (r4 advisor): only the crossing
+    # wave's requests ever left, not the full rounds * ALPHA walk — a
+    # failed lookup stops generating traffic and learning like
+    # runLookupLoop's deadline abort
+    from dst_libp2p_test_node_tpu.ops import kad as kad_mod
+
+    assert int(k2.queries_tx[3]) <= kad_mod.ALPHA
 
 
 def test_sd_simulator_end_to_end():
