@@ -50,7 +50,21 @@ def main() -> None:
         )
     )
     graph = build_connection_graph(N_PEERS, 10, seed=0)
-    params = SimParams(n=N_PEERS, capacity=graph.capacity)
+    # Throughput is measured in the BOUNDED delivery mode, the mode the
+    # 100k/1M ladder configs run (accounting/attribution carry the exact
+    # serialized answer queues; arrival times keep the unserialized value
+    # in the cases where a queued answer would deliver first, with the max
+    # queue wait exported as the error bar — see SimParams.serialize_answers
+    # and README "Delivery-fidelity modes"). The EXACT mode is the model of
+    # record for every validity artifact; its per-publish cost at this
+    # shape is measured below and reported as publish_exact_s: at
+    # heartbeat < dissemination span, queued answers bind on every message
+    # and the exact repair pays ~15-20 extra fixpoint passes.
+    import dataclasses
+
+    params = SimParams(n=N_PEERS, capacity=graph.capacity,
+                       serialize_answers=False)
+    params_exact = dataclasses.replace(params, serialize_answers=True)
     state = init_state(params, seed=0)
     a = graph_arrays(graph)
     import jax.numpy as jnp
@@ -153,6 +167,29 @@ def main() -> None:
         jax.block_until_ready(s2.bytes_tx)
         full_s = min(full_s, time.time() - t1)
 
+    # model-fidelity attribution (r5): the same publish in the EXACT
+    # serialized-answer mode (the model of record). The difference against
+    # publish_full_s is the honest cost of exact answer-queue
+    # serialization at this shape, where heartbeat < dissemination span
+    # makes queued answers bind on every message (~15-20 extra fixpoint
+    # passes of tick/request refinement).
+    def _exact(s, pub):
+        res, s = disseminate(
+            s, a["conns"], a["rev"], stage, lat, bw, publisher=pub,
+            t0_ms=s.t_ms, params=params_exact, payload_bytes=15000,
+            lat_edge=lat_edge,
+        )
+        return res, s
+
+    r0, s0 = _exact(state, 21)
+    jax.block_until_ready(s0.bytes_tx)              # compile
+    exact_s = np.inf
+    for i in range(3):
+        t1 = time.time()
+        _, s2 = _exact(state, 22 + i)
+        jax.block_until_ready(s2.bytes_tx)
+        exact_s = min(exact_s, time.time() - t1)
+
     rounds = MESSAGES * per_burst
     value = N_PEERS * rounds / wall
     # coverage and percentiles over ALL timed messages, not the last one's
@@ -181,6 +218,17 @@ def main() -> None:
             "fixpoint_s": round(fix_s, 3),
             "accounting_s": round(max(full_s - fix_s, 0.0), 3),
             "publish_full_s": round(full_s, 3),
+            # bounded vs exact delivery mode (see SimParams
+            # .serialize_answers): the timed loop runs bounded; this is
+            # the exact-mode publish on the same state — the measured
+            # price of exact answer-queue serialization at this shape
+            "delivery_mode": "bounded",
+            "publish_exact_s": round(exact_s, 3),
+            # the bounded mode's per-hop arrival-time error bar: max time
+            # any requested answer waited queued (ms), max over messages
+            "answer_wait_max_ms": round(
+                max(float(np.asarray(r.answer_wait_max_ms))
+                    for r in results), 3),
             "backend": jax.default_backend(),
             "coverage": coverage,               # all timed messages
             "coverage_warmup": coverage_warmup,

@@ -45,7 +45,7 @@ answers included — through the queue in arrival order, exactly).
 Cross-fragment rx contention inside one message is not modeled: same-sender
 fragments are spaced k*tx >= rx_ms apart by the uplink queue, so only
 interleaved different-sender duplicates could bind, a second-order effect.
-Answered IWANTs SERIALIZE on the answering uplink (gossip_serial below): a
+Answered IWANTs SERIALIZE on the answering uplink (gossip_fold below): a
 peer answering k IWANTs in one gossip round transmits the answers
 back-to-back in IWANT-arrival order — sum, not max — and a round's backlog
 spills into the next round's queue, the way the reference's per-connection
@@ -182,6 +182,15 @@ class DisseminationResult:
     #                            "message" mode loses it outright. Lossy runs
     #                            verify the tcp-mode negligibility claim
     #                            against this counter instead of trusting it.
+    answer_wait_max_ms: jnp.ndarray  # () float32 — bounded delivery mode
+    #                            (params.serialize_answers=False) ONLY: the
+    #                            max time any requested gossip answer waited
+    #                            queued behind another at the final times —
+    #                            the per-hop bound on how far an arrival
+    #                            time may sit below the exact serialized
+    #                            model's. 0.0 in the exact default mode
+    #                            (the repair makes the times exact) and
+    #                            whenever no answer ever queued.
 
 
 def _stage_select(stage: jnp.ndarray, n_stages: int, conns: jnp.ndarray,
@@ -536,6 +545,114 @@ def disseminate(
         r = _frag_slice(retx_ms, frag_idx)
         return ld if r is None else ld + r
 
+    # ---- serialized gossip-answer machinery --------------------------------
+    # Static service order for the per-round queue fold: within a round all
+    # of a sender's IWANTs arrive at A_h + 2*lat (A_h shared per sender-
+    # round), so arrival order IS lat order — a permutation of each row
+    # that never changes across fragments, phases or estimates. Sorting
+    # once here turns every fold into elementwise work plus within-row
+    # take_along gathers (the r5 bench catch: per-estimate global argsorts
+    # cost more than the whole r4 publish).
+    if with_gossip:
+        _slot_lat = jnp.where(conns >= 0, lat_edge, INF)
+        perm_lat = jnp.argsort(_slot_lat, axis=-1, stable=True)   # (N, C)
+        inv_lat = jnp.argsort(perm_lat, axis=-1, stable=True)
+        lat_sorted = jnp.take_along_axis(_slot_lat, perm_lat, axis=-1)
+        conns_sorted = jnp.take_along_axis(conns, perm_lat, axis=-1)
+        gw_sorted = [
+            jnp.take_along_axis(g_tgt_w[h], perm_lat, axis=-1)
+            for h in range(n_rounds)
+        ]
+
+    def _sorted_frag(x, frag_idx):
+        """Per-fragment slice of a (F/None, N, C) array, in lat order."""
+        xs = _frag_slice(x, frag_idx)
+        return None if xs is None else jnp.take_along_axis(
+            xs, perm_lat, axis=-1)
+
+    def gossip_fold(t_rx, frag_idx):
+        """Exact serialized gossip-answer offers via the per-round fold.
+
+        A peer answering several IWANTs serializes the answers on its
+        uplink — the reference's per-connection queues all feed the host's
+        single host_bandwidth_up under Shadow (main.nim:264-299,
+        shadow/topogen.py:50-51) — a single-server queue in IWANT-arrival
+        order, rounds chaining through the carried busy time. Processing
+        round-by-round in the static lat order is EXACT as long as rounds
+        don't interleave (a round's last requested arrival precedes the
+        next round's first — true whenever the heartbeat exceeds the
+        round-trip spread, i.e. always at reference heartbeats); the fold
+        detects the interleaved corner and reports it in `mixed`, which
+        routes the message to the global-sort slow path. Only requested
+        jobs (receiver still lacking at the IHAVE, survive-gated) occupy
+        the queue; every sampled edge still gets an offer — the time its
+        answer WOULD arrive if requested — which is self-consistent
+        because an offer can only bind for a still-lacking receiver.
+
+        Returns (g_abs, req_any, drain, mixed, wait_max): per-edge
+        absolute offers (INF where no sampled live edge), answered flags,
+        per-peer answer queue drain (0 if none), the scalar interleave
+        flag, and the scalar MAX WAIT any requested answer spent queued
+        behind another (serve - arrival) — the per-hop error bound of the
+        bounded delivery mode (serialize_answers=False)."""
+        base = t_rx + params.proc_delay_ms
+        tick = _next_heartbeat(base, hb_phase, params.heartbeat_ms)  # (N,)
+        live = can_send & (t_rx < INF)
+        sv_s = _sorted_frag(survive, frag_idx)
+        retx_s = _sorted_frag(retx_ms, frag_idx)
+        lda_s = lat_sorted * ans_scale
+        if retx_s is not None:
+            lda_s = lda_s + retx_s
+        q_t_s = t_rx[jnp.clip(conns_sorted, 0)]   # receiver times, lat order
+        txp = tx_ms[:, None]
+        busy = uplink                               # (N,) queue busy carry
+        g_sorted = jnp.full((n, c), INF)
+        req_any_s = jnp.zeros((n, c), bool)
+        had_req = jnp.zeros((n,), bool)
+        mixed = jnp.bool_(False)
+        wait_max = jnp.float32(0.0)
+        prev_max_w = jnp.full((n,), -INF)
+        for h in range(n_rounds):
+            a_h = jnp.maximum(
+                tick + h * params.heartbeat_ms, uplink)[:, None]
+            samp = gw_sorted[h] & live[:, None]
+            w = a_h + 2.0 * lat_sorted              # INF on pads/late slots
+            req = samp & (q_t_s > a_h + lat_sorted)
+            if sv_s is not None:
+                # a lossy edge loses the IHAVE with the copy: no IWANT back
+                req = req & sv_s
+            # interleave check: this round's earliest requested arrival vs
+            # the previous round's latest
+            min_w = jnp.where(req, w, INF).min(axis=-1)
+            mixed = mixed | jnp.any(min_w < prev_max_w - 1e-4)
+            prev_max_w = jnp.maximum(
+                prev_max_w, jnp.where(req, w, -INF).max(axis=-1))
+            rf = req.astype(jnp.float32)
+            R = jnp.cumsum(rf, axis=-1)
+            m_term = jnp.where(req, w - (R - 1.0) * txp, -INF)
+            M = jax.lax.cummax(m_term, axis=m_term.ndim - 1)
+            M_prev = jnp.concatenate(
+                [jnp.full_like(M[:, :1], -INF), M[:, :-1]], axis=-1)
+            R_prev = jnp.concatenate(
+                [jnp.zeros_like(R[:, :1]), R[:, :-1]], axis=-1)
+            serve = jnp.maximum(
+                w, jnp.maximum(busy[:, None], M_prev) + R_prev * txp)
+            offer = serve + txp + lda_s
+            g_sorted = jnp.minimum(g_sorted, jnp.where(samp, offer, INF))
+            wait_max = jnp.maximum(
+                wait_max, jnp.where(req, serve - w, 0.0).max())
+            req_any_s = req_any_s | req
+            r_last = R[:, -1]
+            busy = jnp.where(
+                r_last > 0.0,
+                jnp.maximum(busy, M[:, -1]) + r_last * tx_ms, busy)
+            had_req = had_req | (r_last > 0.0)
+        g_abs = jnp.take_along_axis(g_sorted, inv_lat, axis=-1)
+        g_abs = jnp.where(g_abs < INF, g_abs, INF)  # overflow -> sentinel
+        req_any = jnp.take_along_axis(req_any_s, inv_lat, axis=-1)
+        drain = jnp.where(had_req, busy, 0.0)
+        return g_abs, req_any, drain, mixed, wait_max
+
     def _gossip_jobs(t_rx, frag_idx):
         """Shared job builder of the serialized answer model: per sampled
         (round h, slot i) job, its IWANT arrival W = announce departure +
@@ -572,30 +689,6 @@ def disseminate(
             serve_hni + tx_ms[:, None, None] + lda[:, None, :], axis=1)
         # overflowed INF+finite arithmetic back to the sentinel
         return jnp.where(g_abs < INF, g_abs, INF)
-
-    def gossip_light(t_rx, frag_idx):
-        """No-queue gossip-answer offers + the SOUNDNESS HINT.
-
-        Valid exactly when no answer server ever holds two requested jobs
-        — then every answer starts at its own IWANT arrival (serve = W)
-        and the serialized model coincides with the unserialized one.
-        `hint` is the sound overapproximation of that condition: any
-        sender with >= 2 requested jobs across all rounds. hint=False
-        PROVES the fast path exact (one job can never wait behind
-        itself); hint=True only routes to the exact serialized branch.
-        Contains no lax.cond and no sort, so it is safe (and cheap) under
-        the fragment vmap — a batched lax.cond would lower to select_n
-        and execute BOTH branches (the r5 review catch).
-
-        Returns (g_abs, req_any, drain, hint)."""
-        Wf, rf = _gossip_jobs(t_rx, frag_idx)
-        req_any = rf.reshape(n, n_rounds, c).any(axis=1)
-        g_abs = _offers_from_serve(Wf, frag_idx)
-        # with <= 1 requested job per server, that job's serve end IS the
-        # drain: max over requested jobs of W + tx (0 when none)
-        drain = jnp.where(rf, Wf + tx_ms[:, None], 0.0).max(axis=-1)
-        hint = jnp.any(rf.sum(axis=-1) >= 2)
-        return g_abs, req_any, drain, hint
 
     def gossip_serial_exact(t_rx, frag_idx):
         """Exact serialized gossip-answer offers at the estimate t_rx.
@@ -646,8 +739,8 @@ def disseminate(
         `deliver_only`: additionally mask copies the network loses — use for
         anything receiver-side (first-sender detection, delivery pulls);
         leave False for transmit-side accounting (sends, tx bytes).
-        `g_abs`: the serialized gossip-answer offers of gossip_serial
-        evaluated at the SAME t_rx (required when with_gossip)."""
+        `g_abs`: the serialized gossip-answer offers of gossip_fold /
+        gossip_serial_exact evaluated at the SAME t_rx (required when with_gossip)."""
         base = t_rx + params.proc_delay_ms
         start = jnp.maximum(base, uplink)
         ld = _ld_mesh(frag_idx)
@@ -864,15 +957,13 @@ def disseminate(
         dropped = frag_idx + 1.0 > params.send_queue_cap
         return tgt_mask & ~(is_pub & dropped)
 
-    def _phase2_masks(t1, rank1, k1, tgt_f, frag_idx, g_abs1_del):
+    def _phase2_masks_from_inc(inc1, t1, rank1, k1, tgt_f):
         """Back-edge removal: drop each peer's slot toward its first sender
         from the send order — the slot is simply never occupied. The first
-        sender is whoever DELIVERED (lost copies can't be it, and only
-        REQUESTED gossip answers were ever transmitted — the unanswered
-        edges' hypothetical offers never bind and must not steal the
-        attribution argmin; `g_abs1_del` comes pre-masked by the caller)."""
-        inc1 = pull(offers(t1, rank1, k1, frag_idx, tgt_f, deliver_only=True,
-                           g_abs=g_abs1_del))
+        sender is whoever DELIVERED: `inc1` is the pulled deliver-only
+        offer matrix at t1 (lost copies masked; gossip offers only on
+        ANSWERED edges — an unanswered edge's hypothetical offer never
+        binds and must not steal the attribution argmin)."""
         first_slot = jnp.argmin(inc1, axis=-1)
         # the min offer equals t1 BY CONSTRUCTION at the fixpoint (every
         # reached non-publisher peer's time IS some offer), but offers() and
@@ -904,63 +995,131 @@ def disseminate(
         k2 = k1 - rm.astype(jnp.float32)
         return rank2, k2, send_mask
 
+    def _diverged(t, inc, mixed):
+        """Self-consistency trigger of the fast path (zero extra cost: it
+        reuses the already-pulled serialized candidates). The unserialized
+        fixpoint t satisfies t = min(unserialized candidates) <= the
+        serialized min; if t also >= the serialized candidate min (within
+        float tolerance), the two coincide and t IS the serialized
+        fixpoint by uniqueness (a hypothetically-earlier self-consistent
+        solution would need its earliest wrong peer justified by
+        strictly-earlier — hence correct — inputs, contradiction). A peer
+        strictly below every serialized candidate means a queued answer
+        it relied on would really arrive later: rerun serialized. `mixed`
+        (interleaved announce rounds, beyond the per-round fold) also
+        forces the exact path."""
+        inc_min = inc.min(axis=-1)
+        tol = 0.05 + 1e-5 * jnp.where(t < INF, t, 0.0)
+        bad = (t < inc_min - tol) & (t < INF) \
+            & (jnp.arange(n) != publisher)
+        return jnp.any(bad) | mixed
+
     def phases_fast(frag_idx, t_pub):
-        """UNSERIALIZED two-phase pipeline + the gossip accounting triple
-        at the final times + the soundness hint. Exact whenever the hint
-        comes back False (see gossip_light); contains no lax.cond, so it
-        is safe under the fragment vmap. The hint is evaluated at BOTH
-        phase results and OR-ed (r5 review catch: requested sets are not
-        monotone in t — phase 2's earlier announce ticks can CREATE
-        contention phase 1 didn't have — so hint(t1) alone certifies only
-        the first-sender step, hint(t2) certifies the final times).
-        Returns (t2, rank2, k2, send_mask, g_abs, req_any, drain, hint)."""
+        """UNSERIALIZED two-phase pipeline, with the serialized answer
+        queues resolved EXACTLY at both phase results by the cheap
+        per-round fold (gossip_fold): the queue delays ride in the
+        attribution pulls and the accounting triple, while the delivery
+        fixpoint stays unserialized. The _diverged triggers (checked at
+        both phases) certify when that is exact — a queued answer only
+        matters if it would have been somebody's FIRST delivery — and
+        route the message to the serialized slow branch otherwise.
+        Contains no lax.cond, so it is safe under the fragment vmap.
+        Returns (t2, rank2, k2, send_mask, g_abs, req_any, drain, inc2,
+        wait, hint) — `wait` is the fold's max answer-queue wait at the
+        final times: 0 when nothing queued; in the bounded delivery mode
+        (params.serialize_answers=False) it is the exported per-hop
+        arrival-time error bound of keeping the fast result."""
         tgt_f = queue_drop(tgt, frag_idx)
         rank1 = _ranks_f32(jnp.where(tgt_f, rprio, INF))
         k1 = tgt_f.sum(axis=-1).astype(jnp.float32)
         t1 = _converge_dyn(rank1, k1, frag_idx, t_pub, tgt_f)
         if with_gossip:
-            g1, req1, _, hint1 = gossip_light(t1, frag_idx)
+            g1, req1, drain1, mixed1, wait1 = gossip_fold(t1, frag_idx)
+            # an interleaved fold is outside its exactness precondition:
+            # in exact mode `mixed` routes to the global-sort slow branch
+            # via the hint; in bounded mode it must not silently
+            # under-report the exported error bar — report it as infinite
+            wait1 = jnp.where(mixed1, INF, wait1)
             ga1 = jnp.where(req1, g1, INF)
         else:
-            ga1, hint1 = None, jnp.bool_(False)
+            ga1 = None
         if not params.exclude_first_sender:
-            g2, req2, drain2, hint2 = _acct_triple_light(t1, frag_idx)
-            return t1, rank1, k1, tgt_f, g2, req2, drain2, hint1 | hint2
-        rank2, k2, send_mask = _phase2_masks(
-            t1, rank1, k1, tgt_f, frag_idx, ga1)
+            inc2 = pull(offers(t1, rank1, k1, frag_idx, tgt_f,
+                               deliver_only=True, g_abs=ga1))
+            hint = (_diverged(t1, inc2, mixed1) if with_gossip
+                    else jnp.bool_(False))
+            if with_gossip:
+                return (t1, rank1, k1, tgt_f, g1, req1, drain1, inc2,
+                        wait1, hint)
+            z = jnp.zeros((n, c), jnp.float32)
+            return (t1, rank1, k1, tgt_f, z, jnp.zeros((n, c), bool),
+                    jnp.zeros((n,), jnp.float32), inc2, jnp.float32(0.0),
+                    hint)
+        inc1 = pull(offers(t1, rank1, k1, frag_idx, tgt_f,
+                           deliver_only=True, g_abs=ga1))
+        rank2, k2, send_mask = _phase2_masks_from_inc(
+            inc1, t1, rank1, k1, tgt_f)
         # phase-2 costs are pointwise <= phase-1 (a send slot was removed
         # from every queue), so t1 is a valid warm start
         t2 = _converge_dyn(rank2, k2, frag_idx, t_pub, send_mask, t_init=t1)
-        g2, req2, drain2, hint2 = _acct_triple_light(t2, frag_idx)
-        return t2, rank2, k2, send_mask, g2, req2, drain2, hint1 | hint2
+        if with_gossip:
+            g2, req2, drain2, mixed2, wait2 = gossip_fold(t2, frag_idx)
+            wait2 = jnp.where(mixed2, INF, wait2)   # see wait1 note
+            ga2 = jnp.where(req2, g2, INF)
+        else:
+            g2 = jnp.zeros((n, c), jnp.float32)
+            req2 = jnp.zeros((n, c), bool)
+            drain2 = jnp.zeros((n,), jnp.float32)
+            ga2, wait2 = None, jnp.float32(0.0)
+        inc2 = pull(offers(t2, rank2, k2, frag_idx, send_mask,
+                           deliver_only=True, g_abs=ga2))
+        if with_gossip:
+            hint = (_diverged(t1, inc1, mixed1)
+                    | _diverged(t2, inc2, mixed2))
+            # error bar covers BOTH folds the fast result relied on (the
+            # t1 fold fed the first-sender attribution)
+            wait_out = jnp.maximum(wait1, wait2)
+        else:
+            hint = jnp.bool_(False)
+            wait_out = wait2
+        return (t2, rank2, k2, send_mask, g2, req2, drain2, inc2, wait_out,
+                hint)
 
-    def _acct_triple_light(t, frag_idx):
-        if not with_gossip:
-            z = jnp.zeros((n, c), jnp.float32)
-            return (z, jnp.zeros((n, c), bool),
-                    jnp.zeros((n,), jnp.float32), jnp.bool_(False))
-        return gossip_light(t, frag_idx)
-
-    def phases_serial(frag_idx, t_pub):
-        """SERIALIZED pipeline: exact answer queues in both phases and in
-        the accounting triple. Reached only from the hint-gated slow
-        branch (a scalar-predicate lax.cond at message level — a real XLA
-        branch, never a batched select), so its sorts and outer passes
-        cost nothing when no answer ever queues."""
+    def phases_serial(frag_idx, t_pub, t_seed):
+        """SERIALIZED pipeline: exact answer queues inside the delivery
+        fixpoint itself (from-INF outer iteration) and in the accounting
+        triple. Reached only from the trigger-gated slow branch (a
+        scalar-predicate lax.cond at message level — a real XLA branch,
+        never a batched select), so its global sorts and outer passes cost
+        nothing unless a QUEUED answer was actually somebody's first
+        delivery (or announce rounds interleaved). `t_seed`: the fast
+        pipeline's final times — a near-correct gossip estimate that cuts
+        the outer passes from reach-expansion count (~10) to tick/request
+        refinement count (~2-3)."""
         tgt_f = queue_drop(tgt, frag_idx)
         rank1 = _ranks_f32(jnp.where(tgt_f, rprio, INF))
         k1 = tgt_f.sum(axis=-1).astype(jnp.float32)
-        t1 = _converge_serialized(rank1, k1, frag_idx, t_pub, tgt_f)
+        t1 = _converge_serialized(rank1, k1, frag_idx, t_pub, tgt_f,
+                                  t_seed=t_seed)
         if not params.exclude_first_sender:
             g2, req2, drain2 = gossip_serial_exact(t1, frag_idx)
-            return t1, rank1, k1, tgt_f, g2, req2, drain2
+            inc2 = pull(offers(t1, rank1, k1, frag_idx, tgt_f,
+                               deliver_only=True,
+                               g_abs=jnp.where(req2, g2, INF)))
+            return t1, rank1, k1, tgt_f, g2, req2, drain2, inc2
         g1, req1, _ = gossip_serial_exact(t1, frag_idx)
-        rank2, k2, send_mask = _phase2_masks(
-            t1, rank1, k1, tgt_f, frag_idx, jnp.where(req1, g1, INF))
+        inc1 = pull(offers(t1, rank1, k1, frag_idx, tgt_f,
+                           deliver_only=True,
+                           g_abs=jnp.where(req1, g1, INF)))
+        rank2, k2, send_mask = _phase2_masks_from_inc(
+            inc1, t1, rank1, k1, tgt_f)
         t2 = _converge_serialized(rank2, k2, frag_idx, t_pub, send_mask,
                                   t_seed=t1)
         g2, req2, drain2 = gossip_serial_exact(t2, frag_idx)
-        return t2, rank2, k2, send_mask, g2, req2, drain2
+        inc2 = pull(offers(t2, rank2, k2, frag_idx, send_mask,
+                           deliver_only=True,
+                           g_abs=jnp.where(req2, g2, INF)))
+        return t2, rank2, k2, send_mask, g2, req2, drain2, inc2
 
     # publisher emits fragments back-to-back (main.nim:177-179)
     frag_ids = jnp.arange(fragments, dtype=jnp.float32)
@@ -973,24 +1132,33 @@ def disseminate(
         outs = [phases_fast(frag_ids[i], t_pubs[i])
                 for i in range(fragments)]
         fast = tuple(jnp.stack(x) for x in zip(*outs))
-    fast_results, hint_f = fast[:7], fast[7]
-    if with_gossip:
+    fast_results, wait_f, hint_f = fast[:8], fast[8], fast[9]
+    # bounded-mode error bar: the max time any requested answer waited
+    # queued at the final estimates — in exact mode the repair (below)
+    # drives the actual delivery error to zero and this reports 0
+    answer_wait = jnp.max(wait_f)
+    if with_gossip and params.serialize_answers:
         # serialized-answer repair, decided ONCE per message on a SCALAR
-        # predicate: hint_f=False proves the unserialized pipeline exact
-        # (no answer server ever held two requested jobs, so nothing could
-        # wait — by uniqueness the unserialized fixpoint IS the serialized
-        # one); hint_f=True reruns the exact serialized pipeline. The
-        # scalar cond is a real branch on TPU — a vmapped cond would
-        # lower to select_n and execute both branches every publish.
-        def _slow(_):
-            outs = [phases_serial(frag_ids[i], t_pubs[i])
+        # predicate (_diverged): the fast pipeline is kept whenever no
+        # queued answer could have been a first delivery and no announce
+        # rounds interleaved — then the unserialized times are themselves
+        # the serialized fixpoint and the triple/inc are already exact.
+        # The scalar cond is a real branch on TPU — a vmapped cond would
+        # lower to select_n and execute both branches every publish (the
+        # r5 review + bench catch). The fast results ride in as the
+        # operand: the slow pipeline seeds its gossip estimates from them.
+        def _slow(fr):
+            t_fast = fr[0]
+            outs = [phases_serial(frag_ids[i], t_pubs[i], t_fast[i])
                     for i in range(fragments)]
             return tuple(jnp.stack(x) for x in zip(*outs))
 
         fast_results = jax.lax.cond(
-            jnp.any(hint_f), _slow, lambda _: fast_results, operand=None)
+            jnp.any(hint_f), _slow, lambda fr: fr, fast_results)
+        # exact mode: the repair drives the delivery error to zero
+        answer_wait = jnp.float32(0.0)
     (t_rx_f, rank_f, k_f, smask_f, g_abs_acct, req_acct,
-     drain_acct) = fast_results
+     drain_acct, inc_acct) = fast_results
 
     received = jnp.all(t_rx_f < INF, axis=0)
     t_rx = jnp.where(received, t_rx_f.max(axis=0), INF)  # last fragment completes
@@ -999,10 +1167,11 @@ def disseminate(
 
     # ---- post-fixpoint accounting (bytes, duplicates, gossip, score) -------
     def frag_accounting(frag_idx, t_rx_one, rank, k_p, send_mask,
-                        g_abs_f, req_any_f, drain_f):
+                        g_abs_f, req_any_f, drain_f, inc):
         # this fragment's loss draw; the gossip triple (answer offers,
-        # answered sets, serialized queue drain) was resolved at the final
-        # times by the phase pipeline — light or exact per the hint branch
+        # answered sets, serialized queue drain) and the pulled
+        # deliver-only offer matrix `inc` were resolved at the final times
+        # by the phase pipeline (fold or exact per the trigger branch)
         sv = _frag_slice(survive, frag_idx)
         if not with_gossip:
             g_abs_f = None
@@ -1011,8 +1180,6 @@ def disseminate(
                       g_abs=g_abs_f)
         made_offer = cand < INF
         # rx side (first-delivery attribution): delivered copies only
-        inc = pull(offers(t_rx_one, rank, k_p, frag_idx, send_mask,
-                          deliver_only=True, g_abs=g_abs_f))
         first_slot = jnp.argmin(inc, axis=-1)
         q_t = neighbor_pull_min(  # neighbor arrival times (fragment-vmapped)
             t_rx_one, conns, rev, batch_factor=fragments)
@@ -1039,7 +1206,7 @@ def disseminate(
             # per-round accounting over the mcache window: every heartbeat
             # tick h the emitter IHAVEs its fresh sample; the receiver
             # IWANTs only if it still lacks the message when the announce
-            # lands — gossip_serial already resolved the answered sets
+            # lands — the phase pipeline already resolved the answered sets
             # (req_any_f) and the serialized drain of each peer's answer
             # queue (drain_f: announce tick, IWANT round trip, then the
             # answers transmitted BACK-TO-BACK on the answering uplink in
@@ -1120,7 +1287,7 @@ def disseminate(
      first_slot_f, slow_f, arr_f, up_end_f, lost_f) = jax.vmap(
         frag_accounting
     )(frag_ids, t_rx_f, rank_f, k_f, smask_f, g_abs_acct, req_acct,
-      drain_acct)
+      drain_acct, inc_acct)
     sends = sends_f.sum(axis=0).astype(jnp.int32)
     lost_tx = lost_f.sum(axis=0).astype(jnp.int32)
     copies = copies_f.sum(axis=0).astype(jnp.int32)
@@ -1162,6 +1329,7 @@ def disseminate(
         ihave_sent=ihave_pp,
         iwant_sent=iwant_pp,
         lost_tx=lost_tx,
+        answer_wait_max_ms=answer_wait,
     )
     dup = jnp.maximum(copies - fragments, 0)
     # uplink occupancy write-back: per fragment, frag_accounting computed the

@@ -71,6 +71,17 @@ class SimParams:
     slow_start: bool = True
     mss_bytes: int = 1460
     initcwnd_segments: int = 10
+    # Exact answered-IWANT serialization in the DELIVERY fixpoint (r5).
+    # Always exact in the accounting (answer-queue drains, answered sets,
+    # attribution offers ride the serialized fold regardless); this flag
+    # additionally REPAIRS the arrival times when a queued answer would
+    # have been somebody's first delivery — which at heartbeat <
+    # dissemination-span shapes (the 100k bench) is every message, at the
+    # honest cost of extra fixpoint passes. False = keep the unserialized
+    # arrival times in exactly those binding cases (the r4-and-earlier
+    # approximation, error <= the answer queue wait, a few tx_ms) — an
+    # A/B attribution knob for the bench, NOT the model of record.
+    serialize_answers: bool = True
     fanout_ttl_ms: float = 60_000.0  # v1.1 fanoutTTL (libp2p default 60 s)
     max_relax_iters: int = 48   # bound on the earliest-arrival fixpoint
     exclude_first_sender: bool = True   # don't forward back to the delivering peer

@@ -36,7 +36,8 @@ from ..config.env import GossipSubParams
 from ..config.topology import Topology, TopoParams
 from .simulator import ExperimentConfig, MessageRecord, Simulator
 
-FORMAT_VERSION = 5  # bump on any SimState layout change (v5: sub/unsub events)
+FORMAT_VERSION = 6  # bump on any SimState layout change (v6: per-record
+#                     answer_wait_max_ms — read tolerantly, so v5 loads too)
 
 
 def _graph_hash(graph) -> str:
@@ -64,6 +65,8 @@ def _records_arrays(records: list[MessageRecord]) -> dict:
         "records/t0_ms": np.asarray([r.t0_ms for r in records], dtype=np.float64),
         "records/ihave": np.asarray([r.ihave for r in records], dtype=np.int64),
         "records/iwant": np.asarray([r.iwant for r in records], dtype=np.int64),
+        "records/answer_wait_max_ms": np.asarray(
+            [r.answer_wait_max_ms for r in records], dtype=np.float64),
         "records/delays_ms": np.stack([r.delays_ms for r in records]),
         "records/received": np.stack([r.received for r in records]),
         "records/sends": np.stack([r.sends for r in records]),
@@ -86,6 +89,10 @@ def _records_from_arrays(z) -> list[MessageRecord]:
             copies_rx=z["records/copies_rx"][i],
             ihave=int(z["records/ihave"][i]),
             iwant=int(z["records/iwant"][i]),
+            # absent in pre-r5 checkpoints: exact mode's bar is 0.0
+            answer_wait_max_ms=(
+                float(z["records/answer_wait_max_ms"][i])
+                if "records/answer_wait_max_ms" in z else 0.0),
         )
         for i in range(n)
     ]
@@ -135,7 +142,9 @@ def load_checkpoint(path: str, mesh=None) -> Simulator:
 
     z = np.load(path)
     meta = json.loads(bytes(z["meta_json"]).decode())
-    if meta["version"] != FORMAT_VERSION:
+    if meta["version"] not in (5, FORMAT_VERSION):
+        # v5 differs only by the absent per-record answer_wait field,
+        # which the record reader defaults — accept both
         raise ValueError(
             f"checkpoint format {meta['version']} != supported {FORMAT_VERSION}"
         )

@@ -100,6 +100,13 @@ class ExperimentConfig:
     # "message" drops whole copies (QUIC-unreliable-style). See
     # ops/disseminate.py loss model constants.
     loss_mode: str = "tcp"
+    # Delivery-fidelity mode (SimParams.serialize_answers): True (default)
+    # = exact answered-IWANT serialization including the delivery repair;
+    # False = bounded mode for the large throughput configs (accounting/
+    # attribution exact, arrival times keep the unserialized value where
+    # queued answers bind, DisseminationResult.answer_wait_max_ms is the
+    # per-hop error bar).
+    serialize_answers: bool = True
     # Message-id layout compat (SURVEY §7 quirks). "nim": a random 64-bit id
     # embedded at payload bytes 8-16 (gossipsub-queues/main.nim:169); "go":
     # the publish timestamp is the dedup key — Go/Rust embed no random id
@@ -140,6 +147,11 @@ def record_from_result(
         copies_rx=np.asarray(res.copies_rx),
         ihave=int(np.asarray(res.ihave_sent).sum()),
         iwant=int(np.asarray(res.iwant_sent).sum()),
+        # result views that slice a block out of a bigger run (multitopic's
+        # per-topic projection) may not carry the scalar; exact mode's bar
+        # is 0.0 anyway
+        answer_wait_max_ms=float(np.asarray(
+            getattr(res, "answer_wait_max_ms", 0.0))),
     )
 
 
@@ -154,6 +166,10 @@ class MessageRecord:
     copies_rx: np.ndarray
     ihave: int
     iwant: int
+    # bounded delivery mode only (SimParams.serialize_answers=False): the
+    # per-hop arrival-time error bar — max time any requested gossip
+    # answer waited queued. 0.0 in the exact default mode.
+    answer_wait_max_ms: float = 0.0
 
     @property
     def receivers(self) -> np.ndarray:
@@ -204,6 +220,7 @@ class Simulator:
             proc_delay_ms=proc_ms,
             churn_down_per_hb=cfg.churn_down_per_hb,
             churn_up_per_hb=cfg.churn_up_per_hb,
+            serialize_answers=cfg.serialize_answers,
         )
         self.state = init_state(self.params, seed=cfg.seed)
         self.arrays = graph_arrays(self.graph)

@@ -31,9 +31,10 @@ This script runs the same shape through the framework: 10,000 peers,
 topology (20-150 ms one-way latencies, 50-150 Mbit), one publish per
 12 s slot. The anchor claim it checks (and docs/VALIDITY.md records):
 
-  - p50 dissemination latency lands in the high-hundreds-of-ms band —
-    BELOW the published ~1-2 s mainnet median (which adds production +
-    validation), and of the same order; and
+  - p50 dissemination latency lands INSIDE the published ~1-2 s mainnet
+    band (as of r5's TCP slow-start model: a 128 KB block pays ~3
+    cold-window RTTs per hop, which is what moved the r4 model's 470 ms
+    up to the band — exactly the residual the r4 verdict predicted); and
   - >= 99% of deliveries beat the 4 s deadline, as mainnet does.
 
 An order-of-magnitude anchor, deliberately not a ±5% gate: the published

@@ -150,7 +150,7 @@ def _event_sim(m: _Model, publisher, t_pub, send_mask, rank, k, frag):
     announce's arrival IWANTs back; the answers queue on the answering
     peer's SINGLE uplink server in IWANT-arrival order, each occupying it
     for one tx time. Written independently of the engine's sorted-prefix
-    fold (ops/disseminate.gossip_serial) so the differential suite
+    fold (ops/disseminate.gossip_fold / gossip_serial_exact) so the differential suite
     discriminates exactly the serialization term.
 
     Returns (t, gossip_arr, server_busy, answered):
@@ -645,6 +645,50 @@ def test_slow_start_adds_rtts_not_bandwidth():
         if abs(got - want) < 1.0:
             moved += 1
     assert checked >= 5 and moved >= 1, (checked, moved)
+
+
+def test_bounded_mode_one_sided_within_reported_wait():
+    # serialize_answers=False (the bounded delivery mode the 100k/1M
+    # throughput configs run): accounting/attribution stay exact, but
+    # arrival times keep the unserialized value where a queued answer
+    # binds. Contract checked here against the chronological DES (= the
+    # exact model): the bounded times are (a) NEVER LATER than the exact
+    # ones (one-sided: dropping queue waits can only advance arrivals),
+    # (b) no earlier than a small multiple of the REPORTED max answer
+    # wait (queue waits can compound along a delivery path, but the path
+    # has few gossip hops), and (c) the report itself is positive exactly
+    # when queues formed.
+    import dataclasses
+
+    # gossip-only + loss: answers carry the traffic and queues form
+    g, params, state, a, (stage, lat, bw) = _setup(
+        128, 8, 70, 3, flood_publish=False)
+    state = state.replace(mesh_mask=jnp.zeros_like(state.mesh_mask))
+    loss_stage = jnp.full((4, 4), 0.15, jnp.float32)
+    pub = 9
+    t0 = float(state.t_ms)
+    pb = dataclasses.replace(params, serialize_answers=False)
+    res_b, _, plan = disseminate(
+        state, a["conns"], a["rev"], stage, lat, bw, publisher=pub,
+        t0_ms=t0, params=pb, payload_bytes=15000, with_gossip=True,
+        loss_stage=loss_stage, loss_mode="message", return_plan=True)
+    wait = float(np.asarray(res_b.answer_wait_max_ms))
+    assert wait > 0.0, "expected answer queues to form at this seed"
+    want_d, want_r = des_delays(
+        np.asarray(a["conns"]), np.asarray(a["rev"]), plan, params, pub,
+        t0, 1)
+    got_d = np.asarray(res_b.delay_ms, np.float64)
+    both = np.asarray(res_b.received) & want_r
+    assert both.sum() > 100
+    diff = want_d[both] - got_d[both]      # exact(DES) - bounded
+    assert (diff >= -0.5).all(), "bounded mode must never be LATER than exact"
+    assert diff.max() <= 10.0 * wait + 0.5, (diff.max(), wait)
+    # the exact default reports zero wait (the repair removes the error)
+    res_e, _ = disseminate(
+        state, a["conns"], a["rev"], stage, lat, bw, publisher=pub,
+        t0_ms=t0, params=params, payload_bytes=15000, with_gossip=True,
+        loss_stage=loss_stage, loss_mode="message")
+    assert float(np.asarray(res_e.answer_wait_max_ms)) == 0.0
 
 
 def test_fixpoint_matches_des_with_graylist():

@@ -101,9 +101,9 @@ def test_validity_doc_matches_anchor_artifact():
 
 def test_metric_of_record_quote_matches_artifact():
     # README/PARITY quote the single-chip peers*rounds/s headline; it must
-    # be the committed bench output (docs/BENCH_LOCAL_r4.json), same drift
+    # be the committed bench output (docs/BENCH_LOCAL_r5.json), same drift
     # class as the ladder table
-    with open(os.path.join(ROOT, "docs", "BENCH_LOCAL_r4.json")) as f:
+    with open(os.path.join(ROOT, "docs", "BENCH_LOCAL_r5.json")) as f:
         bench = json.load(f)
     want = f"{bench['value'] / 1e6:.1f}M"
     for name in ("README.md", "PARITY.md"):
@@ -113,6 +113,51 @@ def test_metric_of_record_quote_matches_artifact():
         assert f"{m[1]}M" == want, (
             f"{name} quotes {m[1]}M peers*rounds/s; committed bench artifact "
             f"says {want} — update the doc")
+
+
+def test_validity_doc_matches_second_anchor_artifact():
+    # the attestation-scale anchor's quoted numbers (docs/VALIDITY.md §2)
+    # must be the committed docs/VALIDITY_ANCHOR2.json values
+    with open(os.path.join(ROOT, "docs", "VALIDITY_ANCHOR2.json")) as f:
+        anchor = json.load(f)["ours"]
+    doc = _read(os.path.join("docs", "VALIDITY.md"))
+    p50s = re.findall(r"\| p50 dissemination \| \*\*(\d+) ms\*\* \|", doc)
+    assert len(p50s) == 2, "VALIDITY.md must quote both anchors' p50"
+    assert int(p50s[1]) == round(anchor["p50_ms"]), (p50s[1], anchor["p50_ms"])
+    m = re.search(r"\| p99 \| (\d+) ms \|", doc)
+    assert m and int(m[1]) == round(anchor["p99_ms"]), (
+        "VALIDITY.md must quote the attestation anchor p99", anchor["p99_ms"])
+
+
+def test_validity_muxer_sensitivity_quotes_match_artifact():
+    # the muxer-axis bound quoted in docs/VALIDITY.md §3 must be the
+    # committed sensitivity table (event_loop_calibration.json)
+    with open(os.path.join(ROOT, "docs", "event_loop_calibration.json")) as f:
+        span = json.load(f)["muxer_sensitivity"]["span"]
+    doc = _read(os.path.join("docs", "VALIDITY.md"))
+    m = re.search(r"p50\s*moves ([\d.]+)%", doc)
+    assert m and float(m[1]) == pytest.approx(span["p50_span_pct"],
+                                              abs=0.006), (
+        m and m[1], span["p50_span_pct"])
+    m = re.search(r"moves it ([\d.]+)%", doc)
+    assert m and float(m[1]) == pytest.approx(span["p50_bound_shift_pct"],
+                                              abs=0.006), (
+        m and m[1], span["p50_bound_shift_pct"])
+
+
+def test_readme_delivery_mode_quotes_match_bench_artifact():
+    # README's delivery-modes section quotes the exact/bounded publish
+    # costs and the bounded-mode error bar; pin them to the bench artifact
+    with open(os.path.join(ROOT, "docs", "BENCH_LOCAL_r5.json")) as f:
+        det = json.load(f)["detail"]
+    readme = _read("README.md")
+    m = re.search(r"([\d.]+) s/publish vs ([\d.]+) s bounded", readme)
+    assert m, "README must quote '<exact> s/publish vs <bounded> s bounded'"
+    assert float(m[1]) == pytest.approx(det["publish_exact_s"], abs=0.0051)
+    assert float(m[2]) == pytest.approx(det["publish_full_s"], abs=0.0051)
+    m = re.search(r"([\d.]+) ms at the bench shape", readme)
+    assert m, "README must quote the bounded-mode error bar"
+    assert float(m[1]) == pytest.approx(det["answer_wait_max_ms"], abs=0.051)
 
 
 def test_readme_loss_tail_matches_artifact():
