@@ -326,6 +326,41 @@ def test_loss_draws_are_per_fragment():
     assert ((retx[0] > 0) != (retx[1] > 0)).any()
 
 
+def test_lost_tx_counter_verifies_negligibility_claim():
+    # r4 advisor: the tcp-mode "abandonment is negligible" claim must be
+    # verifiable from a counter, not trusted. At per-edge loss p, a tcp
+    # copy is abandoned with prob p^(MAX_RETRIES+1); message mode loses
+    # the copy outright with prob p — the counter must show both.
+    from dst_libp2p_test_node_tpu.ops.disseminate import MAX_RETRIES
+
+    loss = 0.5
+    g, params, state, a, (stage, lat, bw) = mesh_setup(seed=11)
+    ls = jnp.full((6, 6), loss, jnp.float32)
+    out = {}
+    for mode in ("tcp", "message"):
+        res, _ = disseminate(
+            state, a["conns"], a["rev"], stage, lat, bw, publisher=0,
+            t0_ms=float(state.t_ms), params=params, payload_bytes=15000,
+            with_gossip=True, loss_stage=ls, loss_mode=mode)
+        out[mode] = (int(np.asarray(res.lost_tx).sum()),
+                     int(np.asarray(res.sends).sum()))
+    lost_t, sent_t = out["tcp"]
+    lost_m, sent_m = out["message"]
+    # message mode: about p of all transmitted copies are lost
+    assert 0.35 <= lost_m / sent_m <= 0.65, (lost_m, sent_m)
+    # tcp mode: only deep-backoff abandonment (p^7 ~ 0.8% at p=0.5) —
+    # a generous band around the expectation, but far below message mode
+    exp = loss ** (MAX_RETRIES + 1)
+    assert lost_t / sent_t <= 6 * exp, (lost_t, sent_t, exp)
+    assert lost_t / sent_t < 0.1 * lost_m / sent_m
+    # lossless runs report zero
+    res0, _ = disseminate(
+        state, a["conns"], a["rev"], stage, lat, bw, publisher=0,
+        t0_ms=float(state.t_ms), params=params, payload_bytes=15000,
+        with_gossip=True)
+    assert int(np.asarray(res0.lost_tx).sum()) == 0
+
+
 def test_fragments_complete_on_last():
     g, params, state, a, (stage, lat, bw) = mesh_setup()
     r1, _ = disseminate(
