@@ -184,6 +184,14 @@ def cmd_run(argv: list[str]) -> int:
                    "RTO retransmission latency (Shadow runs real TCP "
                    "stacks), message = whole-copy drops (QUIC-unreliable "
                    "style)")
+    p.add_argument("--delivery-mode", choices=["exact", "bounded"],
+                   default="exact",
+                   help="answered-IWANT serialization fidelity: exact = "
+                   "the model of record (queued answers repaired into the "
+                   "arrival times); bounded = the 100k+/1M throughput "
+                   "mode (accounting/attribution exact, arrival times "
+                   "keep the unserialized value where a queued answer "
+                   "binds; the max queue wait is the recorded error bar)")
     a = p.parse_args(argv)
     if (a.checkpoint or a.resume) and int(a.runs) != 1:
         # per-run states would overwrite one checkpoint file and a resume
@@ -247,6 +255,7 @@ def cmd_run(argv: list[str]) -> int:
             mix_d=a.mix_d,
             msgid_mode=a.msgid_mode,
             loss_mode=a.loss_mode,
+            serialize_answers=(a.delivery_mode == "exact"),
         )
         t0 = time.time()
         if a.resume:

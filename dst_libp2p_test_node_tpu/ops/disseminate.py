@@ -570,6 +570,23 @@ def disseminate(
         return None if xs is None else jnp.take_along_axis(
             xs, perm_lat, axis=-1)
 
+    def _round_req(h, tick, live, q_t, lat, gw_h, sv):
+        """THE request/announce semantics of the serialized answer model,
+        shared verbatim by the lat-sorted fold and the global-sort exact
+        path (one copy, per the r5 review): round h's IHAVE leaves at
+        A_h = max(tick + h*hb, uplink); a sampled live edge is REQUESTED
+        iff the receiver still lacks the message when the IHAVE lands
+        (strictly q_t > A_h + lat), and a lossy edge loses the IHAVE with
+        the copy (survive-gated), so no IWANT ever comes back on it.
+        Returns (a_h (N,1), sampled, requested) in the caller's layout."""
+        a_h = jnp.maximum(
+            tick + h * params.heartbeat_ms, uplink)[:, None]
+        samp = gw_h & live[:, None]
+        req = samp & (q_t > a_h + lat)
+        if sv is not None:
+            req = req & sv
+        return a_h, samp, req
+
     def gossip_fold(t_rx, frag_idx):
         """Exact serialized gossip-answer offers via the per-round fold.
 
@@ -613,14 +630,9 @@ def disseminate(
         wait_max = jnp.float32(0.0)
         prev_max_w = jnp.full((n,), -INF)
         for h in range(n_rounds):
-            a_h = jnp.maximum(
-                tick + h * params.heartbeat_ms, uplink)[:, None]
-            samp = gw_sorted[h] & live[:, None]
+            a_h, samp, req = _round_req(
+                h, tick, live, q_t_s, lat_sorted, gw_sorted[h], sv_s)
             w = a_h + 2.0 * lat_sorted              # INF on pads/late slots
-            req = samp & (q_t_s > a_h + lat_sorted)
-            if sv_s is not None:
-                # a lossy edge loses the IHAVE with the copy: no IWANT back
-                req = req & sv_s
             # interleave check: this round's earliest requested arrival vs
             # the previous round's latest
             min_w = jnp.where(req, w, INF).min(axis=-1)
@@ -667,13 +679,9 @@ def disseminate(
         q_t = t_rx[jnp.clip(conns, 0)]           # (N, C) receiver times
         Ws, reqs = [], []
         for h in range(n_rounds):
-            a_h = jnp.maximum(
-                tick + h * params.heartbeat_ms, uplink)[:, None]
-            samp = g_tgt_w[h] & live[:, None]
+            a_h, samp, r_h = _round_req(
+                h, tick, live, q_t, lat_edge, g_tgt_w[h], sv)
             Ws.append(jnp.where(samp, a_h + 2.0 * lat_edge, INF))
-            r_h = samp & (q_t > a_h + lat_edge)
-            if sv is not None:
-                r_h = r_h & sv
             reqs.append(r_h)
         Wf = jnp.concatenate(Ws, axis=-1)        # (N, H*C), col = h*C + i
         rf = jnp.concatenate(reqs, axis=-1)

@@ -160,6 +160,12 @@ def test_cli_run_lossy_loss_modes(tmp_path):
     # the flag is live: message mode's recovery tail is strictly later
     # (same seed, common random numbers across the modes)
     assert max(msg) > max(tcp), (max(msg), max(tcp))
+    # --delivery-mode bounded is live through the CLI: same run, arrival
+    # times never LATER than exact (dropping answer-queue waits can only
+    # advance arrivals), same coverage
+    bnd = run_mode(["--delivery-mode", "bounded"], "bnd-")
+    assert len(bnd) == len(tcp)
+    assert max(bnd) <= max(tcp)
 
 
 def test_cli_topogen_positional_and_flag_forms(tmp_path):
