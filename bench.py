@@ -241,4 +241,20 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    # the axon TPU tunnel's remote_compile endpoint intermittently drops
+    # the response body mid-read (observed ~1 in 3 long runs on this
+    # host); the failure is transient and a fresh attempt compiles clean.
+    # One retry keeps the driver's single invocation from losing the
+    # round's bench artifact to that flake.
+    try:
+        main()
+    except Exception as e:  # noqa: BLE001 - retry only the known transient
+        if "remote_compile" not in str(e):
+            raise
+        import sys
+        import time as _t
+
+        print(f"transient backend failure, retrying once: {e}",
+              file=sys.stderr)
+        _t.sleep(30)
+        main()

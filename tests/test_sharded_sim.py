@@ -76,6 +76,31 @@ def test_sharded_tcp_loss_matches_single_device():
 
 
 @pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 (virtual) devices")
+def test_sharded_bounded_mode_matches_single_device():
+    # the bounded delivery mode (serialize_answers=False — what the 1M
+    # sharded platform runs) must also be sharded==single-device, and its
+    # exported error bar must agree across the two executions
+    def cfg():
+        c = _cfg(packet_loss=0.3)
+        c.loss_mode = "message"       # queues form via gossip recovery
+        c.serialize_answers = False
+        return c
+
+    a = Simulator(cfg())
+    a.warmup()
+    ra = a.publish(4)
+
+    b = Simulator(cfg(), mesh=make_peer_mesh(8))
+    b.warmup()
+    rb = b.publish(4)
+
+    np.testing.assert_array_equal(ra.received, rb.received)
+    np.testing.assert_allclose(ra.delays_ms, rb.delays_ms, rtol=1e-5)
+    np.testing.assert_allclose(ra.answer_wait_max_ms, rb.answer_wait_max_ms,
+                               rtol=1e-5)
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 (virtual) devices")
 def test_uneven_shard_rejected():
     with pytest.raises(ValueError):
         Simulator(
