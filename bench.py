@@ -62,9 +62,15 @@ def main() -> None:
     # and the exact repair pays ~15-20 extra fixpoint passes.
     import dataclasses
 
+    # warm_start: cross-publish warm-started fixpoints (certified +
+    # cold-rerun-guarded, so results are bit-identical to cold starts);
+    # the guard's untaken branch costs compile time only, which the bench
+    # excludes. A cold-publish timing below attributes the actual benefit.
     params = SimParams(n=N_PEERS, capacity=graph.capacity,
-                       serialize_answers=False)
-    params_exact = dataclasses.replace(params, serialize_answers=True)
+                       serialize_answers=False, warm_start=True)
+    params_cold = dataclasses.replace(params, warm_start=False)
+    params_exact = dataclasses.replace(params, serialize_answers=True,
+                                       warm_start=False)
     state = init_state(params, seed=0)
     a = graph_arrays(graph)
     import jax.numpy as jnp
@@ -78,15 +84,25 @@ def main() -> None:
 
     # experiment-constant edge tables, built once (the Simulator does the
     # same; rebuilding inside the op cost 71.8 ms/publish at this N)
-    from dst_libp2p_test_node_tpu.ops.disseminate import edge_tables
+    from dst_libp2p_test_node_tpu.ops.disseminate import (
+        answer_tables, edge_tables,
+    )
+    from dst_libp2p_test_node_tpu.ops.pull import neighbor_pull_bool
 
     lat_edge, _ = edge_tables(stage, lat, a["conns"], a["rev"])
+    # also experiment constants: the lat-sorted answer-queue service tables
+    # (two stable argsorts/publish otherwise — the r5 accounting bill) and
+    # the neighbor alive&subscribed validity pull (one row-gather/publish)
+    ans_tables = answer_tables(lat_edge, a["conns"])
+    valid_edge = (a["conns"] >= 0) & neighbor_pull_bool(
+        state.alive & state.subscribed, a["conns"], a["rev"])
 
-    def publish(s, pub):
+    def publish(s, pub, p=None):
         res, s = disseminate(
             s, a["conns"], a["rev"], stage, lat, bw, publisher=pub,
-            t0_ms=s.t_ms, params=params, payload_bytes=15000,
-            lat_edge=lat_edge,
+            t0_ms=s.t_ms, params=p if p is not None else params,
+            payload_bytes=15000, lat_edge=lat_edge,
+            ans_tables=ans_tables, valid_edge=valid_edge,
         )
         return res, s
 
@@ -146,26 +162,45 @@ def main() -> None:
     # the post-fixpoint accounting (pulls, rx fold, counters, write-backs)
     # from the inlined disseminate — the difference against the full call
     # is the accounting cost (VERDICT r3 ask #4's per-pull attribution).
-    def _fix_only(s, pub):
-        res, _ = disseminate(
-            s, a["conns"], a["rev"], stage, lat, bw, publisher=pub,
-            t0_ms=s.t_ms, params=params, payload_bytes=15000,
-            lat_edge=lat_edge,
-        )
-        return res.delay_ms
+    def _probe(keep):
+        def go(s, pub):
+            res, _ = disseminate(
+                s, a["conns"], a["rev"], stage, lat, bw, publisher=pub,
+                t0_ms=s.t_ms, params=params, payload_bytes=15000,
+                lat_edge=lat_edge, ans_tables=ans_tables,
+                valid_edge=valid_edge,
+            )
+            return tuple(getattr(res, k) for k in keep)
+        return jax.jit(go)
 
-    fix_fn = jax.jit(_fix_only)
+    # number-by-number floor: delay_ms alone keeps only the fixpoints;
+    # adding answer_wait keeps the final-times answer-queue fold too — the
+    # difference isolates the fold from the rest of the accounting
+    fix_fn = _probe(("delay_ms",))
+    fold_fn = _probe(("delay_ms", "answer_wait_max_ms"))
     jax.block_until_ready(fix_fn(state, 11))        # compile
+    jax.block_until_ready(fold_fn(state, 11))
     fix_s = np.inf
+    fold_s = np.inf
     full_s = np.inf
+    cold_s = np.inf
+    r, s2 = publish(state, 12, params_cold)
+    jax.block_until_ready(s2.bytes_tx)              # compile cold variant
     for i in range(3):
         t1 = time.time()
         jax.block_until_ready(fix_fn(state, 12 + i))
         fix_s = min(fix_s, time.time() - t1)
         t1 = time.time()
+        jax.block_until_ready(fold_fn(state, 12 + i))
+        fold_s = min(fold_s, time.time() - t1)
+        t1 = time.time()
         r, s2 = publish(state, 12 + i)
         jax.block_until_ready(s2.bytes_tx)
         full_s = min(full_s, time.time() - t1)
+        t1 = time.time()
+        r, s2 = publish(state, 12 + i, params_cold)
+        jax.block_until_ready(s2.bytes_tx)
+        cold_s = min(cold_s, time.time() - t1)
 
     # model-fidelity attribution (r5): the same publish in the EXACT
     # serialized-answer mode (the model of record). The difference against
@@ -177,7 +212,7 @@ def main() -> None:
         res, s = disseminate(
             s, a["conns"], a["rev"], stage, lat, bw, publisher=pub,
             t0_ms=s.t_ms, params=params_exact, payload_bytes=15000,
-            lat_edge=lat_edge,
+            lat_edge=lat_edge, ans_tables=ans_tables, valid_edge=valid_edge,
         )
         return res, s
 
@@ -189,6 +224,16 @@ def main() -> None:
         _, s2 = _exact(state, 22 + i)
         jax.block_until_ready(s2.bytes_tx)
         exact_s = min(exact_s, time.time() - t1)
+
+    # sanity gates on the mode attribution (VERDICT r5 "What's weak" #2):
+    # exact mode strictly ADDS work (the serialized repair + its triggers)
+    # on top of the same bounded pipeline, so a faster-or-zero exact
+    # timing means the probe measured nothing (e.g. a cached/DCE'd call)
+    # and the artifact must not ship it
+    assert exact_s > 0.0, "publish_exact_s == 0.0: exact probe measured nothing"
+    assert exact_s >= full_s, (
+        f"publish_exact_s ({exact_s:.3f}) < publish_full_s ({full_s:.3f}): "
+        "exact mode strictly adds work; the attribution pass is broken")
 
     rounds = MESSAGES * per_burst
     value = N_PEERS * rounds / wall
@@ -217,7 +262,14 @@ def main() -> None:
             # counters and write-backs add on top
             "fixpoint_s": round(fix_s, 3),
             "accounting_s": round(max(full_s - fix_s, 0.0), 3),
+            # fold_s isolates the final-times answer-queue fold (the wait
+            # bar) from the rest of the accounting: keep delay_ms +
+            # answer_wait_max_ms live, DCE everything else
+            "fold_s": round(max(fold_s - fix_s, 0.0), 3),
             "publish_full_s": round(full_s, 3),
+            # the same bounded publish with the cross-publish warm carry
+            # disabled: the measured (wavefront-limited) warm-start benefit
+            "publish_cold_s": round(cold_s, 3),
             # bounded vs exact delivery mode (see SimParams
             # .serialize_answers): the timed loop runs bounded; this is
             # the exact-mode publish on the same state — the measured
@@ -225,10 +277,25 @@ def main() -> None:
             "delivery_mode": "bounded",
             "publish_exact_s": round(exact_s, 3),
             # the bounded mode's per-hop arrival-time error bar: max time
-            # any requested answer waited queued (ms), max over messages
+            # any requested answer waited queued (ms), max over messages.
+            # ALWAYS finite now — the interleaved-rounds corner (where the
+            # per-round fold's bar is unreliable) is a separate COUNT
+            # field instead of the old INF poison that leaked invalid-JSON
+            # Infinity into this artifact; the min() guard keeps the
+            # artifact strict-JSON even if a future regression reintroduces
+            # an infinite bar (json.dumps below also refuses NaN/Inf)
             "answer_wait_max_ms": round(
-                max(float(np.asarray(r.answer_wait_max_ms))
-                    for r in results), 3),
+                min(max(float(np.asarray(r.answer_wait_max_ms))
+                        for r in results), 3.0e38), 3),
+            # fragment lanes whose gossip announce rounds interleaved at
+            # the final times (fold exactness precondition failed there),
+            # summed over the timed messages; 0 at reference heartbeats
+            "answer_interleaved": int(sum(
+                int(np.asarray(r.answer_interleaved)) for r in results)),
+            # every timed fixpoint reached self-consistency under the
+            # iteration cap
+            "converged": bool(all(
+                bool(np.asarray(r.converged)) for r in results)),
             "backend": jax.default_backend(),
             "coverage": coverage,               # all timed messages
             "coverage_warmup": coverage_warmup,
@@ -237,7 +304,9 @@ def main() -> None:
             "p99_ms": float(np.percentile(delays[ok], 99)),
         },
     }
-    print(json.dumps(out))
+    # strict JSON: refuse NaN/Infinity outright (json.dump would emit the
+    # invalid-JSON literal Infinity and downstream parsers choke)
+    print(json.dumps(out, allow_nan=False))
 
 
 if __name__ == "__main__":

@@ -122,6 +122,14 @@ def heartbeat_step(
         alive = jnp.where(alive, ~dies, revives)
         nbr_ok = None   # alive just changed; precomputed masks are stale
         valid_pre = None
+        # the warm-start carry measured arrival offsets on the OLD liveness
+        # set — a revived peer's stale offset (or a died relay's reachability)
+        # makes the re-based seed meaningless, so invalidate the whole carry
+        # (disseminate's certificate would catch a bad seed anyway; this
+        # keeps the next publish on the cheap no-rerun path)
+        warm = jnp.full_like(state.warm_offset_ms, 3.4e38)
+    else:
+        warm = state.warm_offset_ms
 
     if valid_pre is not None:
         valid = valid_pre
@@ -309,6 +317,7 @@ def heartbeat_step(
         fmd=fmd,
         slow_penalty=slow,
         alive=alive,
+        warm_offset_ms=warm,
         t_ms=t + params.heartbeat_ms,
         key=key,
         grafts=state.grafts + graft_tx_inc + og_tx_inc,

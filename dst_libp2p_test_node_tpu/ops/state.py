@@ -84,6 +84,18 @@ class SimParams:
     serialize_answers: bool = True
     fanout_ttl_ms: float = 60_000.0  # v1.1 fanoutTTL (libp2p default 60 s)
     max_relax_iters: int = 48   # bound on the earliest-arrival fixpoint
+    # Warm-started fixpoints: seed each publish's phase-1 relaxation from
+    # the previous message's arrival offsets re-based to the new publish
+    # time (state.warm_offset_ms; INF = no usable carry). The seed is a
+    # heuristic upper bound only, so the fixpoint carries a self-
+    # consistency certificate: any peer left strictly below its supported
+    # value triggers ONE cold from-INF rerun (a scalar lax.cond), making
+    # the result bit-identical to a cold start unconditionally. False
+    # (the default) removes the seed, the certificate and the cond from
+    # the trace — the cond's untaken branch still costs a second compile
+    # of the whole fast pipeline, which long publish loops amortize but
+    # one-shot calls should not pay.
+    warm_start: bool = False
     exclude_first_sender: bool = True   # don't forward back to the delivering peer
     idontwant_threshold_bytes: int = 1000  # go-test-node/main.go:165 (v1.2)
     churn_down_per_hb: float = 0.0  # P(alive peer dies) per heartbeat
@@ -189,6 +201,16 @@ class SimState:
     #                             fixpoint and writes back the exact
     #                             single-server drain time of all copies this
     #                             message delivered (sorted-arrival fold).
+    warm_offset_ms: jnp.ndarray  # (N,) float32 ms — arrival OFFSET
+    #                             (t_rx - t0) of the most recent fully-
+    #                             received message at each peer, INF where
+    #                             it never arrived or the carry is invalid.
+    #                             disseminate() re-bases these to the next
+    #                             publish time as the warm seed of its
+    #                             phase-1 relaxation (params.warm_start);
+    #                             churn and subscription changes invalidate
+    #                             the whole carry to INF (the topology the
+    #                             offsets were measured on is gone).
     t_ms: jnp.ndarray           # () float32 — sim clock
     key: jnp.ndarray            # jax PRNG key
     # cumulative observability counters (reference L5). GRAFT/PRUNE are
@@ -240,6 +262,7 @@ def init_state(params: SimParams, seed: int = 0) -> SimState:
         hb_phase=jax.random.uniform(k_phase, (n,)) * params.heartbeat_ms,
         uplink_free_ms=jnp.zeros((n,), dtype=jnp.float32),
         rx_free_ms=jnp.zeros((n,), dtype=jnp.float32),
+        warm_offset_ms=jnp.full((n,), 3.4e38, dtype=jnp.float32),
         t_ms=jnp.asarray(0.0, dtype=jnp.float32),
         key=key,
         grafts=jnp.zeros((n,), dtype=jnp.int32),

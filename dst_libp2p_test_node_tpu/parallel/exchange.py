@@ -157,41 +157,54 @@ def _inc_from(t_all: jnp.ndarray, c: RecvConstants) -> jnp.ndarray:
 
 def converge_recv(
     t0: jnp.ndarray, c: RecvConstants, max_iters: int, g_floor=None
-) -> jnp.ndarray:
+):
     """Single-shard receiver-side fixpoint (reference for the sharded one).
 
     `g_floor`: optional (N,) per-receiver FROZEN gossip candidate — the
     serialized answer offers of one outer pass of the serialized-answer
     model (ops/disseminate gossip_serial), already row-minimized. Receiver-
-    local, so it joins the row min at zero per-iteration cost."""
+    local, so it joins the row min at zero per-iteration cost.
+
+    Returns (t_rx, inc, converged): the fixpoint, the (N, C) incoming-
+    offer matrix of the loop's LAST pass (the no-change confirmation pass
+    evaluates it at the final times, so it rides out for free — callers
+    reuse it for first-sender attribution and for the warm-start
+    undershoot certificate instead of paying another full pull), and the
+    final change bit inverted (False only when the iteration cap cut the
+    loop, in which case `inc` is one pass stale)."""
 
     def cond(carry):
-        _, changed, it = carry
+        _, _, changed, it = carry
         return changed & (it < max_iters)
 
     def body(carry):
-        t_rx, _, it = carry
+        t_rx, _, _, it = carry
         # downlink clamp: delivery completes no earlier than the receiver's
         # downlink drains prior traffic plus this copy (max distributes over
         # the row min, so clamping the min equals clamping every candidate)
-        inc_min = _inc_from(t_rx, c).min(axis=-1)
+        inc = _inc_from(t_rx, c)
+        inc_min = inc.min(axis=-1)
         if g_floor is not None:
             inc_min = jnp.minimum(inc_min, g_floor)
         t_new = jnp.minimum(t_rx, jnp.maximum(inc_min, c.rx_c))
-        return t_new, jnp.any(t_new < t_rx), it + 1
+        return t_new, inc, jnp.any(t_new < t_rx), it + 1
 
-    t_rx, _, _ = jax.lax.while_loop(cond, body, (t0, jnp.bool_(True), 0))
-    return t_rx
+    inc0 = jnp.full(c.src.shape, INF)
+    t_rx, inc, changed, _ = jax.lax.while_loop(
+        cond, body, (t0, inc0, jnp.bool_(True), 0))
+    return t_rx, inc, ~changed
 
 
 def converge_sharded(
     t0: jnp.ndarray, c: RecvConstants, max_iters: int, mesh: Mesh,
     g_floor=None,
-) -> jnp.ndarray:
+):
     """shard_map fixpoint over the peer axis: rows of the constants live on
     their shard; each iteration all-gathers the (N,) time vector over ICI
     and psums one convergence bit. Identical results to converge_recv
-    (including the optional frozen `g_floor`, which shards with the rows)."""
+    (including the optional frozen `g_floor`, which shards with the rows,
+    and the carried-out (inc, converged) pair — inc rows shard like the
+    constants; converged is replicated by the psum)."""
     rows = P(PEER_AXIS)
     use_floor = g_floor is not None
     if g_floor is None:
@@ -206,28 +219,30 @@ def converge_sharded(
         )
 
         def cond(carry):
-            _, changed, it = carry
+            _, _, changed, it = carry
             return changed & (it < max_iters)
 
         def body(carry):
-            t_l, _, it = carry
+            t_l, _, _, it = carry
             t_all = jax.lax.all_gather(t_l, PEER_AXIS, tiled=True)
-            inc_min = _inc_from(t_all, c_l).min(axis=-1)
+            inc = _inc_from(t_all, c_l)
+            inc_min = inc.min(axis=-1)
             if use_floor:
                 inc_min = jnp.minimum(inc_min, gf_l)
             t_new = jnp.minimum(t_l, jnp.maximum(inc_min, rx_c))
             changed = jax.lax.psum(
                 jnp.any(t_new < t_l).astype(jnp.int32), PEER_AXIS) > 0
-            return t_new, changed, it + 1
+            return t_new, inc, changed, it + 1
 
-        t_l, _, _ = jax.lax.while_loop(cond, body, (t0_l, jnp.bool_(True), 0))
-        return t_l
+        t_l, inc_l, changed, _ = jax.lax.while_loop(
+            cond, body, (t0_l, jnp.full(src.shape, INF), jnp.bool_(True), 0))
+        return t_l, inc_l, ~changed
 
     fn = jax.shard_map(
         local_fix,
         mesh=mesh,
         in_specs=(rows,) * 11,
-        out_specs=rows,
+        out_specs=(rows, rows, P()),
     )
     return fn(t0, c.src, c.a_ms, c.mesh_ok, c.g_ms, c.g_ok, c.g_off,
               c.phase, c.u_ms, c.rx_c, g_floor)

@@ -36,8 +36,11 @@ from ..config.env import GossipSubParams
 from ..config.topology import Topology, TopoParams
 from .simulator import ExperimentConfig, MessageRecord, Simulator
 
-FORMAT_VERSION = 6  # bump on any SimState layout change (v6: per-record
-#                     answer_wait_max_ms — read tolerantly, so v5 loads too)
+FORMAT_VERSION = 7  # bump on any SimState layout change (v7: warm_offset_ms
+#                     cross-publish warm-start carry — older snapshots load
+#                     with the carry defaulted to INF = "no usable carry",
+#                     which is exactly the state a fresh run starts in; v6
+#                     added per-record answer_wait_max_ms, read tolerantly)
 
 
 def _graph_hash(graph) -> str:
@@ -142,9 +145,10 @@ def load_checkpoint(path: str, mesh=None) -> Simulator:
 
     z = np.load(path)
     meta = json.loads(bytes(z["meta_json"]).decode())
-    if meta["version"] not in (5, FORMAT_VERSION):
-        # v5 differs only by the absent per-record answer_wait field,
-        # which the record reader defaults — accept both
+    if meta["version"] not in (5, 6, FORMAT_VERSION):
+        # v5/v6 differ only by absent per-record answer_wait (defaulted by
+        # the record reader) and the absent warm-start carry (defaulted to
+        # INF below) — accept all three
         raise ValueError(
             f"checkpoint format {meta['version']} != supported {FORMAT_VERSION}"
         )
@@ -169,6 +173,12 @@ def load_checkpoint(path: str, mesh=None) -> Simulator:
     state_dict = {
         k.split("/", 1)[1]: z[k] for k in z.files if k.startswith("state/")
     }
+    if "warm_offset_ms" not in state_dict:
+        # pre-v7 snapshot: no warm-start carry was recorded. INF = "no
+        # usable carry" — the next publish simply runs cold, identical to
+        # a fresh run's first message.
+        state_dict["warm_offset_ms"] = np.full(
+            (cfg.topo.network_size,), 3.4e38, dtype=np.float32)
     sim.state = serialization.from_state_dict(sim.state, state_dict)
     # the publish-path fanout decision reads a host mirror of subscription
     sim._subscribed_np = np.asarray(sim.state.subscribed).copy()
@@ -181,6 +191,11 @@ def load_checkpoint(path: str, mesh=None) -> Simulator:
         from ..parallel.sharding import shard_simulation
 
         sim.state, _, _ = shard_simulation(sim.state, {}, {}, mesh)
+    # the constructor hoisted _valid_edge from its FRESH state; recompute it
+    # against the restored alive/subscribed vectors or the publish path would
+    # route through peers the checkpoint had unsubscribed
+    if sim._valid_edge is not None:
+        sim._valid_edge = sim._compute_valid_edge()
     sim._hb_carry_ms = float(meta["hb_carry_ms"])
     sim._msg_rng.bit_generator.state = meta["msg_rng_state"]
     sim._last_msg_id = int(meta.get("last_msg_id", -1))

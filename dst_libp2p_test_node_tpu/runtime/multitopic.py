@@ -145,11 +145,16 @@ class MultiTopicSimulator:
         )
         # stage-pair edge tables: experiment constants, built once (the
         # tiled stage/conns arrays make them valid across topic blocks)
-        from ..ops.disseminate import edge_tables
+        from ..ops.disseminate import answer_tables, edge_tables
 
         self._lat_edge, self._loss_edge = edge_tables(
             self._stage, self._lat, self.arrays["conns"], self.arrays["rev"],
             self._loss)
+        # lat-sorted answer-queue service tables: also experiment constants
+        # (lat_edge + conns only), hoisted off the per-publish path
+        self._ans_tables = (
+            answer_tables(self._lat_edge, self.arrays["conns"])
+            if cfg.with_gossip else None)
 
         rng = np.random.default_rng(np.random.SeedSequence([cfg.seed, 0x709]))
         self.subscribed_np = np.ones((tcount, n), dtype=bool)
@@ -176,6 +181,11 @@ class MultiTopicSimulator:
             self._lat_edge = reshard_rows(self._lat_edge, mesh)
             if self._loss_edge is not None:
                 self._loss_edge = reshard_rows(self._loss_edge, mesh)
+            if self._ans_tables is not None:
+                import jax
+
+                self._ans_tables = jax.tree_util.tree_map(
+                    lambda x: reshard_rows(x, mesh), self._ans_tables)
         self._hb_carry_ms = 0.0
         self.records: list[tuple[str, MessageRecord]] = []
         self._msg_rng = np.random.default_rng(cfg.seed ^ 0x6D736749)
@@ -255,6 +265,7 @@ class MultiTopicSimulator:
             loss_mode=self.cfg.loss_mode,
             lat_edge=self._lat_edge,
             loss_edge=self._loss_edge,
+            ans_tables=self._ans_tables,
             with_fanout=not bool(self.subscribed_np[ti][publisher]),
         )
         # one uplink per physical NODE: fold the per-row occupancy across
@@ -287,6 +298,11 @@ class MultiTopicSimulator:
             copies_rx = res.copies_rx[blk]
             ihave_sent = res.ihave_sent[blk]
             iwant_sent = res.iwant_sent[blk]
+            # SCALARS, not block-sliced: the bounded-mode error bar covers
+            # the whole stacked publish — without this projection
+            # record_from_result's tolerant getattr silently zeroed the bar
+            # for every multitopic record
+            answer_wait_max_ms = res.answer_wait_max_ms
 
         rec = record_from_result(
             _Blk,

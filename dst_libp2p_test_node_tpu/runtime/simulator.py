@@ -107,6 +107,12 @@ class ExperimentConfig:
     # queued answers bind, DisseminationResult.answer_wait_max_ms is the
     # per-hop error bar).
     serialize_answers: bool = True
+    # Cross-publish warm-started fixpoints (SimParams.warm_start): seed
+    # each publish's relaxation from the previous message's arrival
+    # offsets, certified + cold-rerun-guarded so results stay bit-identical
+    # to cold starts. Off by default — the guard's untaken branch doubles
+    # the publish compile, which only long publish loops amortize.
+    warm_start: bool = False
     # Message-id layout compat (SURVEY §7 quirks). "nim": a random 64-bit id
     # embedded at payload bytes 8-16 (gossipsub-queues/main.nim:169); "go":
     # the publish timestamp is the dedup key — Go/Rust embed no random id
@@ -221,6 +227,7 @@ class Simulator:
             churn_down_per_hb=cfg.churn_down_per_hb,
             churn_up_per_hb=cfg.churn_up_per_hb,
             serialize_answers=cfg.serialize_answers,
+            warm_start=cfg.warm_start,
         )
         self.state = init_state(self.params, seed=cfg.seed)
         self.arrays = graph_arrays(self.graph)
@@ -235,12 +242,19 @@ class Simulator:
         )
         # stage-pair edge tables are experiment constants: build them once
         # here instead of 70 ms/publish inside disseminate (ops edge_tables)
-        from ..ops.disseminate import edge_tables
+        from ..ops.disseminate import answer_tables, edge_tables
 
         self._lat_edge, self._loss_edge = edge_tables(
             self._stage, self._lat, self.arrays["conns"], self.arrays["rev"],
             self._loss)
+        # so are the lat-sorted answer-queue service tables (two stable
+        # argsorts per publish otherwise — the r5 bench's accounting bill)
+        self._ans_tables = (
+            answer_tables(self._lat_edge, self.arrays["conns"])
+            if cfg.with_gossip else None)
         if mesh is not None:
+            import jax
+
             from ..parallel.sharding import place_simulation, reshard_rows
 
             (self.state, self.arrays, self._stage, self._lat, self._bw,
@@ -250,6 +264,15 @@ class Simulator:
             self._lat_edge = reshard_rows(self._lat_edge, mesh)
             if self._loss_edge is not None:
                 self._loss_edge = reshard_rows(self._loss_edge, mesh)
+            if self._ans_tables is not None:
+                self._ans_tables = jax.tree_util.tree_map(
+                    lambda x: reshard_rows(x, mesh), self._ans_tables)
+        # neighbor alive&subscribed validity is publish-invariant between
+        # membership changes: maintained here (set_subscribed recomputes,
+        # churn disables the hoist — heartbeats mutate alive on device)
+        self._churny = (cfg.churn_down_per_hb > 0.0
+                        or cfg.churn_up_per_hb > 0.0)
+        self._valid_edge = None if self._churny else self._compute_valid_edge()
         # host mirror of state.subscribed: publish() picks the fanout code
         # path (static arg) without a device sync; keep in sync via
         # set_subscribed()
@@ -270,6 +293,20 @@ class Simulator:
 
             self.mix_params = MixParams(num_mix=cfg.num_mix, mix_d=cfg.mix_d)
             self.mix_params.validate()
+
+    def _compute_valid_edge(self):
+        """Hoisted per-edge delivery validity (connected AND the neighbor
+        alive & subscribed): one row-gather pass here instead of one per
+        publish. Only valid while liveness/membership is static — churny
+        runs keep it None and disseminate falls back in-call."""
+        import jax.numpy as jnp
+
+        from ..ops.pull import neighbor_pull_bool
+
+        conns = self.arrays["conns"]
+        return (conns >= 0) & neighbor_pull_bool(
+            self.state.alive & self.state.subscribed, conns,
+            self.arrays["rev"])
 
     # ---------------------------------------------------------------- phases
 
@@ -297,6 +334,8 @@ class Simulator:
         self._last_msg_id = -1
         self._hb_carry_ms = 0.0
         self.records = []
+        if not self._churny:
+            self._valid_edge = self._compute_valid_edge()
 
     def set_subscribed(self, mask) -> None:
         """Set per-peer topic membership. An unsubscribed peer can still
@@ -321,12 +360,19 @@ class Simulator:
                 self._unsub_events_np + (~mask & self._subscribed_np))
         self._subscribed_np = mask
         sub = jnp.asarray(mask)
+        # membership changed: the warm-start carry measured arrival offsets
+        # on the old membership — invalidate it wholesale (INF = no carry)
+        warm = jnp.full((self.params.n,), 3.4e38, dtype=jnp.float32)
         if self.mesh is not None:
-            # keep the leaf row-sharded like the rest of the state pytree
+            # keep the leaves row-sharded like the rest of the state pytree
             from ..parallel.sharding import reshard_rows
 
             sub = reshard_rows(sub, self.mesh)
-        self.state = self.state.replace(subscribed=sub)
+            warm = reshard_rows(warm, self.mesh)
+        self.state = self.state.replace(subscribed=sub, warm_offset_ms=warm)
+        # refresh the hoisted validity mask against the new membership
+        if not self._churny:
+            self._valid_edge = self._compute_valid_edge()
 
     def advance(self, ms: float) -> None:
         """Advance simulated time by `ms`, running the heartbeats due."""
@@ -418,6 +464,8 @@ class Simulator:
             loss_mode=cfg.loss_mode,
             lat_edge=self._lat_edge,
             loss_edge=self._loss_edge,
+            ans_tables=self._ans_tables,
+            valid_edge=self._valid_edge,
             # unsubscribed publisher -> gossipsub v1.1 fanout publish
             with_fanout=not bool(self._subscribed_np[publisher]),
         )

@@ -84,3 +84,47 @@ def test_churn_gate_tracks_derivation(tmp_path):
     assert math.isclose(
         bc.expected_alive_fraction(0.001, 0.0005, 1e9), 1.0 / 3.0,
         rel_tol=1e-6)
+
+
+def test_bench_artifact_emission_is_strict_json():
+    # the r5 artifact leaked the invalid-JSON literal Infinity through the
+    # bounded-mode wait bar once; the emitter must now refuse NaN/Inf
+    # outright and the committed artifacts must strict-parse
+    src = open(os.path.join(REPO, "bench.py")).read()
+    assert "allow_nan=False" in src, \
+        "bench.py must emit with json.dumps(..., allow_nan=False)"
+
+    def _refuse(const):
+        raise ValueError(f"non-finite literal {const} in committed artifact")
+
+    import glob
+    arts = glob.glob(os.path.join(REPO, "docs", "BENCH_LOCAL_*.json"))
+    assert arts
+    for path in arts:
+        with open(path) as f:
+            json.loads(f.read(), parse_constant=_refuse)
+
+
+def test_bench_guards_exact_mode_attribution():
+    # VERDICT r5 "What's weak" #2: publish_exact_s: 0.0 shipped once (the
+    # probe measured a cached call). The bench must refuse to emit an
+    # artifact where the exact probe measured nothing or measured LESS
+    # than the bounded publish it strictly adds work to.
+    src = open(os.path.join(REPO, "bench.py")).read()
+    assert "assert exact_s > 0.0" in src
+    assert "assert exact_s >= full_s" in src
+    # and the emission happens after the gates: the asserts must precede
+    # the json.dumps line in the source
+    assert src.index("assert exact_s > 0.0") < src.index("json.dumps(out")
+
+
+def test_bounded_ladder_wait_bar_stays_finite():
+    # bench_configs guards the bounded rows' error bar the same way: the
+    # min() clamp keeps the committed ladder strict-JSON even against a
+    # regression reintroducing an infinite bar
+    with open(bc.ARTIFACT) as f:
+        rows = [json.loads(x) for x in f if x.strip()]
+    for r in rows:
+        if r.get("delivery_mode") == "bounded":
+            assert math.isfinite(r["answer_wait_max_ms"])
+            assert r["answer_wait_max_ms"] >= 0.0

@@ -176,3 +176,78 @@ def test_graph_mismatch_fails_loudly(tmp_path):
     np.savez_compressed(path, **z)
     with pytest.raises(ValueError, match="graph mismatch"):
         load_checkpoint(path)
+
+
+def test_warm_carry_survives_checkpoint(tmp_path):
+    # v7: the cross-publish warm-start carry is a SimState leaf now; a
+    # resumed warm run must continue from the same carry and stay
+    # bit-identical to the uninterrupted one
+    import numpy as np
+
+    sim = Simulator(_cfg(warm_start=True))
+    sim.warmup()
+    sim.publish(4)
+    path = str(tmp_path / "warm.npz")
+    save_checkpoint(sim, path)
+    restored = load_checkpoint(path)
+    np.testing.assert_array_equal(
+        np.asarray(sim.state.warm_offset_ms),
+        np.asarray(restored.state.warm_offset_ms))
+    a = _finish(sim)
+    b = _finish(restored)
+    np.testing.assert_array_equal(a.received, b.received)
+    np.testing.assert_array_equal(a.delays_ms, b.delays_ms)
+
+
+def test_pre_v7_checkpoint_loads_with_inf_carry(tmp_path):
+    # a v6 snapshot has no warm_offset_ms leaf: loading must default the
+    # carry to the INF sentinel ("no usable carry" — the state a fresh run
+    # starts in) and resume identically to a cold continuation
+    import json
+
+    import numpy as np
+
+    sim = Simulator(_cfg())
+    sim.warmup()
+    sim.publish(4)
+    path = str(tmp_path / "v7.npz")
+    save_checkpoint(sim, path)
+    # rewrite as a v6 snapshot: drop the carry leaf, stamp the old version
+    z = np.load(path)
+    meta = json.loads(bytes(z["meta_json"]).decode())
+    meta["version"] = 6
+    arrays = {k: z[k] for k in z.files
+              if k not in ("meta_json", "state/warm_offset_ms")}
+    arrays["meta_json"] = np.frombuffer(
+        json.dumps(meta).encode(), dtype=np.uint8)
+    old = str(tmp_path / "v6.npz")
+    np.savez_compressed(old, **arrays)
+
+    restored = load_checkpoint(old)
+    assert float(np.asarray(restored.state.warm_offset_ms).min()) > 1e30
+    a = _finish(sim)
+    b = _finish(restored)
+    np.testing.assert_array_equal(a.received, b.received)
+    np.testing.assert_array_equal(a.delays_ms, b.delays_ms)
+
+
+def test_restored_valid_edge_tracks_restored_subscriptions(tmp_path):
+    # the publish path hoists a validity mask from alive&subscribed at
+    # construction; load_checkpoint replaces the state AFTER construction,
+    # so the mask must be recomputed against the RESTORED vectors — or a
+    # peer the checkpoint had unsubscribed would silently keep receiving
+    import numpy as np
+
+    sim = Simulator(_cfg())
+    sim.warmup()
+    sub = np.asarray(sim.state.subscribed).copy()
+    sub[7] = False
+    sim.set_subscribed(sub)
+    path = str(tmp_path / "unsub.npz")
+    save_checkpoint(sim, path)
+    restored = load_checkpoint(path)
+    a = _finish(sim)
+    b = _finish(restored)
+    assert not a.received[7] and not b.received[7]
+    np.testing.assert_array_equal(a.received, b.received)
+    np.testing.assert_array_equal(a.delays_ms, b.delays_ms)

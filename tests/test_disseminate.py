@@ -502,3 +502,44 @@ def test_determinism_same_key():
     r2, _ = disseminate(state, a["conns"], a["rev"], stage, lat, bw,
                         publisher=7, t0_ms=0.0, params=params, payload_bytes=15000)
     np.testing.assert_array_equal(np.asarray(r1.delay_ms), np.asarray(r2.delay_ms))
+
+
+def test_lost_tx_counts_network_losses_only_not_graylist_drops():
+    # lost_tx must be drawn against the LOSS-ONLY survive mask: a
+    # receiver-side graylist ignore is not a network loss (the bytes
+    # arrived and were discarded above the transport). Folding the
+    # graylist gate into the counter inflated "network-lost" copies
+    # whenever score thresholds were armed.
+    g, params, state, a, (stage, lat, bw) = mesh_setup(
+        seed=11, slow_weight=-1.0, graylist_threshold=-50.0)
+    # a third of the peers graylist peer 0 (the publisher)
+    rng = np.random.default_rng(7)
+    conns = np.asarray(a["conns"])
+    slow = np.zeros(state.slow_penalty.shape, np.float32)
+    for r in rng.choice(100, size=33, replace=False):
+        slow[r, conns[r] == 0] = 100.0
+    gray = state.replace(slow_penalty=jnp.asarray(slow))
+
+    def run(s, ls):
+        res, _, plan = disseminate(
+            s, a["conns"], a["rev"], stage, lat, bw, publisher=0,
+            t0_ms=float(s.t_ms), params=params, payload_bytes=15000,
+            with_gossip=True, loss_stage=ls, loss_mode="message",
+            return_plan=True)
+        return res, plan
+
+    # no network loss at all: the graylist drops delivery on a third of
+    # the publisher's edges (the combined survive mask has holes), yet
+    # ZERO copies were network-lost
+    res, plan = run(gray, None)
+    assert plan["survive"] is not None and not bool(plan["survive"].all())
+    assert int(np.asarray(res.lost_tx).sum()) == 0
+
+    # with loss active AND the graylist firing, the lost ratio must track
+    # the network loss probability alone (~p of transmitted copies) — the
+    # old counter folded the graylisted edges in on top of p
+    ls = jnp.full((6, 6), 0.3, jnp.float32)
+    res_l, _ = run(gray, ls)
+    lost = int(np.asarray(res_l.lost_tx).sum())
+    sent = int(np.asarray(res_l.sends).sum())
+    assert 0.2 <= lost / sent <= 0.4, (lost, sent)

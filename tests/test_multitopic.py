@@ -135,3 +135,34 @@ def test_phase_shared_across_topics():
     s = MultiTopicSimulator(_cfg())
     ph = np.asarray(s.state.hb_phase).reshape(len(s.cfg.topics), s.n_peers)
     assert (ph == ph[0]).all()
+
+
+def test_record_wait_bar_is_the_whole_publish_scalar():
+    # the bounded-mode error bar covers the WHOLE stacked publish; the
+    # per-topic result window must project it as a scalar — block-slicing
+    # it (or omitting it) would make record_from_result's tolerant getattr
+    # silently zero the bar on every multitopic record
+    import dataclasses
+
+    from dst_libp2p_test_node_tpu.runtime.simulator import record_from_result
+
+    class Blk:  # minimal result window with a known scalar bar
+        delay_ms = np.array([0.0, 1.0])
+        received = np.array([True, True])
+        sends = np.array([1, 0])
+        copies_rx = np.array([0, 1])
+        ihave_sent = np.array([0, 0])
+        iwant_sent = np.array([0, 0])
+        answer_wait_max_ms = 7.5
+
+    rec = record_from_result(Blk, msg_id=1, publisher=0, t0_ms=0.0)
+    assert rec.answer_wait_max_ms == 7.5
+
+    # end-to-end in bounded mode: the recorded bar is scalar and finite
+    s = MultiTopicSimulator(_cfg(topics=("blocks", "attestations")))
+    s.params = dataclasses.replace(s.params, serialize_answers=False)
+    s.warmup()
+    rec = s.publish("blocks", publisher=3)
+    assert np.ndim(rec.answer_wait_max_ms) == 0
+    assert np.isfinite(rec.answer_wait_max_ms)
+    assert rec.answer_wait_max_ms >= 0.0
