@@ -235,6 +235,67 @@ def main() -> None:
         f"publish_exact_s ({exact_s:.3f}) < publish_full_s ({full_s:.3f}): "
         "exact mode strictly adds work; the attribution pass is broken")
 
+    # adversarial-campaign probe (ops/adversary.py): one sybil graft-flood
+    # window + one censored publish at the bench shape, timed as a single
+    # attack trial — BENCH tracks attack_trials_per_s alongside the metric
+    # of record. The bench params leave score defenses statically compiled
+    # out (slow_weight == 0), so the probe arms the attack score surface;
+    # warm_start off because the attacked state diverges from the warm
+    # carry's certificate.
+    from dst_libp2p_test_node_tpu.ops.adversary import (
+        AdversaryParams, attacker_cohort, censor_mask,
+        run_attacked_heartbeats,
+    )
+
+    adv = AdversaryParams(scenario="sybil_graft_flood")
+    params_attack = dataclasses.replace(
+        params, slow_weight=-10.0, slow_decay=0.9, graylist_threshold=-50.0,
+        gossip_threshold=-10.0, publish_threshold=-20.0, warm_start=False)
+    att = attacker_cohort(N_PEERS, 0.1, seed=0)
+    att_j = jnp.asarray(att)
+    censor = censor_mask(att_j, a["conns"])
+    ATTACK_HB = 10
+
+    def _attack_trial(s):
+        s, obs = run_attacked_heartbeats(
+            s, a["conns"], a["rev"], a["out_mask"], att_j, params_attack,
+            adv, ATTACK_HB)
+        res, s = disseminate(
+            s, a["conns"], a["rev"], stage, lat, bw, publisher=4,
+            t0_ms=s.t_ms, params=params_attack, payload_bytes=15000,
+            lat_edge=lat_edge, ans_tables=ans_tables, valid_edge=valid_edge,
+            censor_edge=censor,
+        )
+        return res, obs, s
+
+    res_a, obs_a, s_a = _attack_trial(state0)
+    jax.block_until_ready(s_a.bytes_tx)             # compile
+    attack_s = np.inf
+    for _ in range(3):
+        t1 = time.time()
+        res_a, obs_a, s_a = _attack_trial(state0)
+        jax.block_until_ready(s_a.bytes_tx)
+        attack_s = min(attack_s, time.time() - t1)
+    att_score = float(np.asarray(obs_a["attacker_score_mean"])[-1])
+    gray_frac = float(np.asarray(obs_a["graylisted_frac"])[-1])
+    honest = ~att
+    cov_attack = float(
+        (np.asarray(res_a.delay_ms)[honest] < 1e30).mean())
+    attack_trials_per_s = 1.0 / attack_s
+    # sanity gates, same style as the exact-mode gates above: an unarmed
+    # score surface or a DCE'd window shows up as a non-negative attacker
+    # score / zero graylisting, and then the probe measured nothing
+    assert att_score < 0.0, (
+        f"attacker_score {att_score} >= 0: the attack window left no "
+        "score signal; the probe params are not armed")
+    assert gray_frac > 0.0, (
+        "graylisted_frac == 0 after the attack window: defense never "
+        "engaged; the probe measured nothing")
+    assert cov_attack >= 0.95, (
+        f"honest coverage {cov_attack} under sybil graft-flood: the "
+        "censored publish broke honest delivery")
+    assert np.isfinite(attack_trials_per_s) and attack_trials_per_s > 0.0
+
     rounds = MESSAGES * per_burst
     value = N_PEERS * rounds / wall
     # coverage and percentiles over ALL timed messages, not the last one's
@@ -300,6 +361,19 @@ def main() -> None:
             "coverage": coverage,               # all timed messages
             "coverage_warmup": coverage_warmup,
             "timed_messages": MESSAGES,
+            # adversarial-campaign probe: one armed sybil graft-flood
+            # window (ATTACK_HB heartbeats) + one censored publish,
+            # min-of-3 trials on the fixed post-warm-up state
+            "attack_trials_per_s": round(attack_trials_per_s, 3),
+            "attack": {
+                "scenario": "sybil_graft_flood",
+                "attacker_fraction": 0.1,
+                "attack_heartbeats": ATTACK_HB,
+                "trial_s": round(attack_s, 3),
+                "honest_coverage": round(cov_attack, 4),
+                "attacker_score": round(att_score, 2),
+                "graylisted_frac": round(gray_frac, 4),
+            },
             "p50_ms": float(np.percentile(delays[ok], 50)),
             "p99_ms": float(np.percentile(delays[ok], 99)),
         },

@@ -5,6 +5,8 @@
   3. 10k peers, MULTI-TOPIC, IHAVE/IWANT heartbeat + peer scoring
   4. 100k peers, fragmented publish (FRAGMENTS=4), churn + mesh pruning
   5. 1M peers, mix-routed (MOUNTSMIX/MIXD=4)  [--all only; ~minutes]
+  6. 2k peers, adversarial campaign (sybil graft-flood sweep)
+     [--attack / --only 6; never written to BENCH_CONFIGS.json]
 
 Each config prints ONE JSON line: config id, peers, wall seconds,
 peers*rounds/sec, coverage, p50/p99 dissemination latency (ms). Run:
@@ -209,7 +211,57 @@ def config_5():
                 messages=2, warmup_s=30.0, serialize_answers=False)
 
 
-CONFIGS = {1: config_1, 2: config_2, 3: config_3, 4: config_4, 5: config_5}
+def config_6():
+    """Adversarial campaign (runtime/campaign.py): sybil graft-flood sweep,
+    fractions {0, 0.1} x seeds {0, 1}. OPT-IN (--attack or --only 6) and
+    deliberately NOT part of the committed BENCH_CONFIGS.json ladder — the
+    README config table is pinned to that artifact (test_doc_tripwire); the
+    tracked series here is attack_trials_per_s."""
+    from dst_libp2p_test_node_tpu.runtime.campaign import (
+        CampaignConfig, attack_gossipsub, run_campaign)
+    from dst_libp2p_test_node_tpu.runtime.simulator import ExperimentConfig
+
+    n = 2048
+    cfg = CampaignConfig(
+        scenario="sybil_graft_flood",
+        fractions=(0.0, 0.1),
+        seeds=(0, 1),
+        experiment=ExperimentConfig(
+            topo=_topo(n, 2000), connect_to=10,
+            gossipsub=attack_gossipsub(), warmup_s=30.0, seed=0),
+        attack_heartbeats=20,
+    )
+    res = run_campaign(cfg)
+    attacked = [t for t in res.trials if t.fraction > 0]
+    # worst-case honest view across the attacked cells: the resilience gate
+    cov = min(t.honest_coverage for t in attacked)
+    p50 = max(t.latency_p50_ms for t in attacked)
+    p99 = max(t.latency_p99_ms for t in attacked)
+    engaged = max(t.hb_to_graylist for t in attacked)
+    hb_ms = cfg.experiment.gossipsub.heartbeat_ms
+    per_trial = (cfg.experiment.warmup_s * 1000.0 // hb_ms
+                 + (cfg.experiment.topo.messages - 1)
+                 * cfg.experiment.topo.delay_seconds * 1000.0 // hb_ms)
+    rounds = per_trial * len(res.trials) + cfg.attack_heartbeats * len(attacked)
+    out = {
+        "config": 6,
+        "peers": n,
+        "wall_s": round(res.wall_s, 2),
+        "peer_rounds_per_sec": round(n * rounds / max(res.wall_s, 1e-9), 1),
+        "coverage": round(cov, 4),
+        "p50_ms": round(p50, 1),
+        "p99_ms": round(p99, 1),
+        "scenario": res.scenario,
+        "attack_trials_per_s": round(res.trials_per_s, 4),
+        "hb_to_graylist": engaged if math.isfinite(engaged) else None,
+        "hb_budget": res.hb_budget,
+    }
+    print(json.dumps(out, allow_nan=False), flush=True)
+    return out
+
+
+CONFIGS = {1: config_1, 2: config_2, 3: config_3, 4: config_4, 5: config_5,
+           6: config_6}
 
 ARTIFACT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "BENCH_CONFIGS.json")
@@ -265,6 +317,16 @@ def check_results(results: list[dict], artifact_path: str = ARTIFACT) -> list[st
             fail(c, f"p50 {p50} outside [40, p99={p99}]")
         if p99 > 20_000.0:
             fail(c, f"p99 {p99} ms beyond any sane dissemination horizon")
+        # attack config: the tracked throughput series must be live and
+        # the defense must engage within the closed-form heartbeat budget
+        if c == 6:
+            if not r.get("attack_trials_per_s", 0.0) > 0.0:
+                fail(c, "attack_trials_per_s not positive")
+            if r.get("hb_to_graylist") is None:
+                fail(c, "graylist never engaged under sybil graft-flood")
+            elif r["hb_to_graylist"] > r["hb_budget"]:
+                fail(c, f"graylist engagement {r['hb_to_graylist']} hb "
+                        f"beyond the closed-form budget {r['hb_budget']}")
         # wall-time regression budget vs the committed artifact
         base = committed.get(c)
         if base and r["wall_s"] > base["wall_s"] * WALL_BUDGET:
@@ -276,6 +338,9 @@ def check_results(results: list[dict], artifact_path: str = ARTIFACT) -> list[st
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--all", action="store_true", help="include the 1M config")
+    p.add_argument("--attack", action="store_true",
+                   help="append the adversarial-campaign config (6); never "
+                        "part of the committed BENCH_CONFIGS.json ladder")
     p.add_argument("--only", type=int, choices=sorted(CONFIGS), default=None)
     p.add_argument("--check", action="store_true",
                    help="apply per-config gates; exit 1 on any failure")
@@ -283,14 +348,19 @@ def main():
                    help="write the results as the new artifact (JSON lines)")
     a = p.parse_args()
     runs = [a.only] if a.only else ([1, 2, 3, 4, 5] if a.all else [1, 2, 3, 4])
+    if a.attack and not a.only:
+        runs.append(6)
     results = [CONFIGS[c]() for c in runs]
     failures = check_results(results) if a.check else []
     for f in failures:
         print(f"GATE FAIL: {f}", file=sys.stderr)
     if a.write and not failures:
         with open(a.write, "w") as fh:
+            # the attack config never enters the committed ladder: the
+            # README config table is pinned to the artifact's rows
             for r in results:
-                fh.write(json.dumps(r) + "\n")
+                if r["config"] != 6:
+                    fh.write(json.dumps(r) + "\n")
     if failures:
         sys.exit(1)
 

@@ -17,6 +17,10 @@ Subcommands:
   inject     — publisher controller: POST /publish to node services at a
                fixed inter-message delay (pod-api-requester / traffic_sync.py
                analog, shadow/Dockerfile:45-53, topogen.py:124-136).
+  attack     — adversarial Monte-Carlo campaign (runtime/campaign.py): sweep
+               attacker fraction x seed for one of the v1.1 attack scenarios
+               (ops/adversary.py, arXiv:2007.02754) and report resilience
+               metrics against the score defense.
   kad        — role-based kad-dht workload (bootstrap/normal/probe).
   connmanager — hub-and-spoke watermark/reconnect stress workload.
   servicedisco — advertise/lookup service discovery over the DHT.
@@ -299,6 +303,112 @@ def cmd_run(argv: list[str]) -> int:
                     f,
                     indent=2,
                 )
+    return 0
+
+
+def cmd_attack(argv: list[str]) -> int:
+    """Adversarial campaign driver: one scenario, a fraction x seed grid,
+    resilience report + optional JSON/Prometheus artifacts."""
+    p = argparse.ArgumentParser(prog="attack")
+    from .ops.adversary import SCENARIOS
+
+    p.add_argument("--scenario", choices=SCENARIOS,
+                   default="sybil_graft_flood")
+    p.add_argument("-n", "--peers", type=int, default=256)
+    p.add_argument("--fractions", default="0,0.1,0.2",
+                   help="comma-separated attacker fractions in [0, 1); "
+                   "include 0 for the in-sweep benign baseline")
+    p.add_argument("--seeds", default="0",
+                   help="comma-separated trial seeds (the Monte-Carlo axis)")
+    p.add_argument("--messages", type=int, default=3)
+    p.add_argument("--msg-size", type=int, default=2000)
+    p.add_argument("--delay-s", type=float, default=1.0,
+                   help="inter-message delay in the publish schedule")
+    p.add_argument("--warmup-s", type=float, default=30.0)
+    p.add_argument("--attack-heartbeats", type=int, default=20,
+                   help="attacked mesh-maintenance rounds before publishing")
+    p.add_argument("--connect-to", type=int, default=10)
+    p.add_argument("--seed", type=int, default=0,
+                   help="campaign seed: builds the shared connection graph")
+    p.add_argument("--publisher-id", type=int, default=4)
+    p.add_argument("--violation-penalty", type=float, default=1.0)
+    p.add_argument("--no-vmap", action="store_true",
+                   help="run same-fraction trials sequentially instead of "
+                   "one vmapped attack window")
+    p.add_argument("--warm-start", action="store_true",
+                   help="cross-publish warm-started fixpoints (long "
+                   "schedules)")
+    p.add_argument("--mesh", action="store_true",
+                   help="shard the peer axis over all visible devices "
+                   "(peers must divide evenly by the device count)")
+    p.add_argument("--checkpoint-dir", default=None,
+                   help="snapshot each trial's post-window state here")
+    p.add_argument("--json", default=None,
+                   help="write the campaign result as strict JSON here")
+    p.add_argument("--metrics-out", default=None,
+                   help="write Prometheus text exposition of the "
+                   "dst_testnode_attack_* series here")
+    a = p.parse_args(argv)
+
+    from .ops.adversary import AdversaryParams
+    from .runtime.campaign import (
+        CampaignConfig, attack_gossipsub, run_campaign)
+    from .runtime.simulator import ExperimentConfig
+    from .runtime.summarize import report_campaign
+
+    fractions = tuple(float(s) for s in a.fractions.split(",") if s.strip())
+    seeds = tuple(int(s) for s in a.seeds.split(",") if s.strip())
+    # eclipse needs a mesh-bound publish to have anything to eclipse
+    gs = attack_gossipsub(
+        flood_publish=(a.scenario != "eclipse_publisher"))
+    cfg = CampaignConfig(
+        scenario=a.scenario,
+        fractions=fractions,
+        seeds=seeds,
+        experiment=ExperimentConfig(
+            topo=TopoParams(
+                network_size=a.peers, anchor_stages=3,
+                msg_size_bytes=a.msg_size, messages=a.messages,
+                delay_seconds=a.delay_s),
+            connect_to=a.connect_to,
+            gossipsub=gs,
+            publisher_id=a.publisher_id,
+            warmup_s=a.warmup_s,
+            seed=a.seed,
+            warm_start=a.warm_start,
+        ),
+        adversary=AdversaryParams(
+            scenario=a.scenario, violation_penalty=a.violation_penalty),
+        attack_heartbeats=a.attack_heartbeats,
+        vmap_trials=not a.no_vmap,
+        checkpoint_dir=a.checkpoint_dir,
+    )
+    mesh = None
+    if a.mesh:
+        from .parallel.sharding import make_peer_mesh
+
+        mesh = make_peer_mesh()
+        if a.peers % len(mesh.devices.flat) != 0:
+            p.error(f"--mesh needs peers ({a.peers}) divisible by the "
+                    f"device count ({len(mesh.devices.flat)})")
+    t0 = time.time()
+    res = run_campaign(cfg, mesh=mesh)
+    wall = time.time() - t0
+    d = res.to_dict()
+    print(report_campaign(d), end="")
+    if a.json:
+        with open(a.json, "w") as f:
+            # strict JSON: non-finite metrics are already nulled by to_dict
+            json.dump(d, f, indent=2, allow_nan=False)
+    if a.metrics_out:
+        from .runtime.metrics import CampaignMetrics
+
+        m = CampaignMetrics()
+        m.fill_from_campaign(d)
+        with open(a.metrics_out, "w") as f:
+            f.write(m.render())
+    print(f"[tpu backend] wall={wall:.2f}s trials={len(res.trials)} "
+          f"trials/s={res.trials_per_s:.3f}")
     return 0
 
 
@@ -603,6 +713,8 @@ def main(argv: list[str] | None = None) -> int:
         return cmd_summarize(rest)
     if cmd == "serve":
         return cmd_serve(rest)
+    if cmd == "attack":
+        return cmd_attack(rest)
     if cmd == "inject":
         return cmd_inject(rest)
     if cmd == "kad":

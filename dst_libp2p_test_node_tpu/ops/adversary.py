@@ -1,0 +1,354 @@
+"""Adversarial perturbations: the v1.1 attack scenarios as on-device masks.
+
+"GossipSub: Attack-Resilient Message Propagation in the Filecoin and ETH2.0
+Networks" (arXiv:2007.02754) evaluates the v1.1 score function against a
+small canon of attacks. This module expresses that canon inside the engine's
+existing fixed-shape algebra — every attacker behavior is a masked (N,)/(N, C)
+op riding the same reciprocal-pull involution and the same dissemination
+fixpoint as benign traffic, so a 100k-peer attack round costs the same order
+as a benign heartbeat and NOTHING here loops over peers in Python:
+
+  sybil_graft_flood   attacker rows force-graft every valid edge each
+                      heartbeat (plus the censorship behavior below — sybils
+                      contribute nothing). Honest peers answer with the v1.1
+                      defense: a re-GRAFT of an edge that is backed off or
+                      already meshed is a protocol violation that accrues the
+                      behaviour-penalty counter.
+  ihave_spam          attacker rows announce `spam_ihaves_per_hb` bogus ids
+                      to every valid edge each heartbeat; honest peers IWANT
+                      the unseen ids and the answers never come (broken
+                      IWANT promises -> the same penalty counter).
+  censorship          in-mesh attackers silently refuse to forward: a
+                      per-edge DELIVERY drop mask (censor_mask) folded into
+                      disseminate's `survive` exactly like the graylist
+                      gate — distinct from `survive_loss`, so lost_tx keeps
+                      counting network losses only.
+  eclipse_publisher   the attacker cohort is drawn from the publisher's
+                      connected neighbors and the publisher's mesh row is
+                      overwritten with attacker edges only (eclipse_setup);
+                      with flood_publish off, the first publishes die inside
+                      the cohort until scoring evicts it.
+  cold_boot_join      the graft-flood scenario started from the un-warmed
+                      t=0 state: the mesh must FORM while under attack.
+
+Penalty plumbing. The engine's score model is the v1.1 subset the reference
+actually configures (P2 firstMessageDeliveries + the slow-peer penalty
+counter, ops/state.py score()). The slow-peer counter is libp2p's negative-
+weighted "non-negative counter x weight < 0" shape — exactly the shape of
+v1.1's P7 behaviour penalty — so attack violations accrue into
+`state.slow_penalty` and the full defense chain downstream is the EXISTING
+one: score() -> gossip/publish thresholds -> graylist delivery gating in
+disseminate -> score-ranked prune + score>=0 graft eligibility in
+heartbeat_step. Campaign configs must set slow_peer_penalty_weight < 0 or
+the static `thresholds_can_bind` gate compiles every defense out
+(ops/disseminate.py) — attack_gossipsub() in runtime/campaign.py does.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .heartbeat import heartbeat_step
+from .pull import neighbor_pull_bool, reciprocal_pull_bool
+from .state import SimParams, SimState
+
+SCENARIOS = (
+    "sybil_graft_flood",
+    "ihave_spam",
+    "censorship",
+    "eclipse_publisher",
+    "cold_boot_join",
+)
+
+
+@dataclass(frozen=True)
+class AdversaryParams:
+    """Static (hashable -> jit static arg) attack-scenario parameters."""
+
+    scenario: str = "sybil_graft_flood"
+    # behaviour-penalty counter increment per protocol violation per
+    # heartbeat (re-GRAFT of a backed-off/meshed edge; unanswered IWANT)
+    violation_penalty: float = 1.0
+    # P3-analog: counter increment per publish on a mesh edge whose member
+    # silently delivered nothing (censorship_penalty_update)
+    censor_penalty: float = 1.0
+    # bogus IHAVE ids announced per valid edge per heartbeat (ihave_spam)
+    spam_ihaves_per_hb: int = 8
+
+    def validate(self) -> None:
+        if self.scenario not in SCENARIOS:
+            raise ValueError(
+                f"unknown scenario {self.scenario!r}; expected one of {SCENARIOS}")
+        if self.violation_penalty <= 0.0 or self.censor_penalty < 0.0:
+            raise ValueError("violation_penalty must be > 0, censor_penalty >= 0")
+        if self.spam_ihaves_per_hb < 1:
+            raise ValueError("spam_ihaves_per_hb must be >= 1")
+
+    # scenario -> active behaviors (all derived, keeping the dataclass a
+    # pure static key: one flag per scenario would multiply trace keys)
+    @property
+    def graft_flood(self) -> bool:
+        return self.scenario in ("sybil_graft_flood", "eclipse_publisher",
+                                 "cold_boot_join")
+
+    @property
+    def ihave_spam(self) -> bool:
+        return self.scenario == "ihave_spam"
+
+    @property
+    def eclipse(self) -> bool:
+        return self.scenario == "eclipse_publisher"
+
+    @property
+    def cold_boot(self) -> bool:
+        return self.scenario == "cold_boot_join"
+
+
+def attacker_cohort(
+    n: int,
+    fraction: float,
+    seed: int,
+    conns: np.ndarray | None = None,
+    publisher: int | None = None,
+    eclipse: bool = False,
+) -> np.ndarray:
+    """(N,) bool attacker membership — host-side TRIAL SETUP (one draw per
+    trial, not per peer per round). Deterministic in (seed, fraction).
+
+    `eclipse`: fill the cohort from the publisher's connected neighbors
+    first (the attacker placed its sybils on the victim's connection slots),
+    then at random; the publisher itself is never an attacker."""
+    if not (0.0 <= fraction < 1.0):
+        raise ValueError(f"attacker fraction must be in [0, 1), got {fraction}")
+    k = int(round(fraction * n))
+    mask = np.zeros(n, dtype=bool)
+    if k == 0:
+        return mask
+    rng = np.random.default_rng(
+        np.random.SeedSequence([seed, int(fraction * 1e6), 0xAD5E]))
+    candidates = np.arange(n)
+    if publisher is not None:
+        candidates = candidates[candidates != publisher]
+    if eclipse:
+        if conns is None or publisher is None:
+            raise ValueError("eclipse cohort needs conns and publisher")
+        nbrs = np.asarray(conns)[publisher]
+        nbrs = np.unique(nbrs[nbrs >= 0])
+        nbrs = nbrs[nbrs != publisher]
+        take = nbrs[:k] if len(nbrs) > k else nbrs
+        mask[take] = True
+        k -= len(take)
+        candidates = candidates[~mask[candidates]]
+    if k > 0:
+        mask[rng.choice(candidates, size=k, replace=False)] = True
+    return mask
+
+
+def heartbeats_to_graylist(adv: AdversaryParams, params: SimParams) -> float:
+    """The DOCUMENTED engagement budget: heartbeats from attack start until
+    every violated honest->attacker edge scores below graylist_threshold.
+
+    The penalty counter on a violated edge follows c_k = d*c_{k-1} + p
+    (heartbeat decay, then the round's accrual), so after k accrual rounds
+    c_k = p(1-d^k)/(1-d). The edge is graylisted when
+    slow_weight*c_k <= graylist_threshold, i.e. c_k >= G/w (both negative).
+    Violations start on round 2 for graft-flood (round 1's grafts are
+    accepted into empty backoff/mesh; every re-graft after violates) and
+    round 1 for ihave_spam. Returns inf when the steady-state counter
+    p/(1-d) can never reach the requirement — the campaign should treat
+    that as a config error, not wait forever."""
+    if params.slow_weight >= 0.0:
+        return math.inf  # thresholds_can_bind is False: defenses compiled out
+    c_req = params.graylist_threshold / params.slow_weight
+    p = adv.violation_penalty
+    d = params.slow_decay
+    lead_in = 1.0 if adv.ihave_spam else 2.0
+    if c_req <= p:
+        return lead_in  # first accrual already crosses
+    rhs = 1.0 - c_req * (1.0 - d) / p
+    if rhs <= 0.0:
+        return math.inf
+    return lead_in - 1.0 + math.ceil(math.log(rhs) / math.log(d))
+
+
+def censor_mask(attacker: jnp.ndarray, conns: jnp.ndarray) -> jnp.ndarray:
+    """(N, C) per-edge delivery drop mask: every out-edge of an attacker row.
+    Folded into disseminate's `survive` (delivery only — the graylist
+    semantics), NOT into `survive_loss`: a withheld copy is not a network
+    loss. The censor's own tx accounting keeps the queue slot, modeling a
+    lying node that claims to forward."""
+    return attacker[:, None] & (conns >= 0)
+
+
+def eclipse_setup(
+    state: SimState, conns: jnp.ndarray, attacker: jnp.ndarray, publisher: int
+) -> SimState:
+    """Overwrite the publisher's mesh row with its attacker edges only —
+    the moment the eclipse closes (every slot the victim meshes through is
+    a sybil). The attacker rows keep/gain the reciprocal edges through the
+    graft-flood behavior; honest recovery happens through the normal
+    heartbeat (graft fills the row back when scoring empties it)."""
+    # only the publisher's row is touched: gather its neighbors directly
+    # (nbr_is_attacker[i] = attacker[conns[pub, i]]) instead of a full pull
+    row = jnp.where(conns[publisher] >= 0,
+                    attacker[jnp.clip(conns[publisher], 0)], False)
+    mesh = state.mesh_mask.at[publisher].set(row)
+    return state.replace(mesh_mask=mesh)
+
+
+@partial(jax.jit, static_argnames=("params", "adv", "batch_factor"))
+def adversary_round(
+    state: SimState,
+    conns: jnp.ndarray,
+    rev: jnp.ndarray,
+    attacker: jnp.ndarray,
+    params: SimParams,
+    adv: AdversaryParams,
+    batch_factor: int = 1,
+    nbr_ok: jnp.ndarray | None = None,
+):
+    """One heartbeat of attacker behavior + honest defense accounting,
+    applied AFTER heartbeat_step. Returns (new_state, obs) where obs holds
+    the per-round scalar observables the campaign's engagement/recovery
+    metrics are built from. All ops are fixed-shape masked array passes."""
+    t = state.t_ms
+    if nbr_ok is None:
+        nbr_ok = neighbor_pull_bool(
+            state.alive & state.subscribed, conns, rev, batch_factor)
+    valid = ((conns >= 0) & state.alive[:, None] & nbr_ok
+             & state.subscribed[:, None])
+    att_row = attacker[:, None] & valid   # attacker out-edges
+    honest = ~attacker & state.alive & state.subscribed
+
+    mesh = state.mesh_mask
+    slow_penalty = state.slow_penalty
+    grafts, grafts_rx = state.grafts, state.grafts_rx
+    ihave_tx, ihave_rx = state.ihave_tx, state.ihave_rx
+    iwant_tx, iwant_rx = state.iwant_tx, state.iwant_rx
+
+    if adv.graft_flood:
+        # the attacker GRAFTs every valid edge, every heartbeat, ignoring
+        # backoff. The receive side is one reciprocal pull; v1.1 handleGraft
+        # accepts a first graft (no backoff, grafter not negatively scored)
+        # and treats a re-GRAFT of a backed-off or already-meshed edge as
+        # the graft-flood violation (go-libp2p-pubsub adds a behaviour
+        # penalty for exactly this).
+        flood = att_row
+        rx = reciprocal_pull_bool(flood, conns, rev, batch_factor)
+        violation = rx & ((state.backoff_until > t) | mesh)
+        sc = state.score(params)
+        accept = rx & ~violation & (sc >= 0.0)
+        mesh = (mesh | flood | accept) & valid
+        slow_penalty = slow_penalty + jnp.where(
+            violation, jnp.float32(adv.violation_penalty), 0.0)
+        grafts = grafts + flood.sum(axis=-1, dtype=jnp.int32)
+        grafts_rx = grafts_rx + rx.sum(axis=-1, dtype=jnp.int32)
+
+    if adv.ihave_spam:
+        # bogus IHAVEs on every valid attacker edge; honest receivers IWANT
+        # each unseen id and the answer never comes — the broken-promise
+        # violation accrues once per spammed edge per heartbeat (the v1.1
+        # IWANT-timeout behaviour penalty, applied at the round grain)
+        ann = att_row
+        rx_ann = reciprocal_pull_bool(ann, conns, rev, batch_factor)
+        k = jnp.int32(adv.spam_ihaves_per_hb)
+        ihave_tx = ihave_tx + ann.sum(axis=-1, dtype=jnp.int32) * k
+        ihave_rx = ihave_rx + rx_ann.sum(axis=-1, dtype=jnp.int32) * k
+        # IWANT flows back along the same involution: honest tx, attacker rx
+        iwant_tx = iwant_tx + rx_ann.sum(axis=-1, dtype=jnp.int32) * k
+        iwant_rx = iwant_rx + ann.sum(axis=-1, dtype=jnp.int32) * k
+        slow_penalty = slow_penalty + jnp.where(
+            rx_ann, jnp.float32(adv.violation_penalty), 0.0)
+
+    new_state = state.replace(
+        mesh_mask=mesh, slow_penalty=slow_penalty,
+        grafts=grafts, grafts_rx=grafts_rx,
+        ihave_tx=ihave_tx, ihave_rx=ihave_rx,
+        iwant_tx=iwant_tx, iwant_rx=iwant_rx,
+    )
+
+    # -- per-round observables (scalars; the scan stacks them) ---------------
+    sc = new_state.score(params)
+    att_nbr = neighbor_pull_bool(attacker, conns, rev, batch_factor)
+    h_att_edge = valid & att_nbr & honest[:, None]   # honest view of attackers
+    n_e = jnp.maximum(h_att_edge.sum(), 1)
+    f32 = jnp.float32
+    obs = {
+        # fraction of honest->attacker edges the receiver graylists
+        "graylisted_frac": (h_att_edge
+                            & (sc < params.graylist_threshold)).sum() / f32(n_e),
+        "attacker_score_mean": jnp.where(h_att_edge, sc, 0.0).sum() / f32(n_e),
+        # attacker share of honest peers' mesh edges (mesh recovery metric)
+        "attacker_mesh_share": (
+            (mesh & att_nbr & honest[:, None]).sum()
+            / f32(jnp.maximum((mesh & honest[:, None]).sum(), 1))),
+        "honest_mean_degree": (
+            (mesh & honest[:, None]).sum()
+            / f32(jnp.maximum(honest.sum(), 1))),
+    }
+    return new_state, obs
+
+
+@partial(jax.jit, static_argnames=("params", "adv", "steps", "batch_factor"))
+def run_attacked_heartbeats(
+    state: SimState,
+    conns: jnp.ndarray,
+    rev: jnp.ndarray,
+    out_mask: jnp.ndarray,
+    attacker: jnp.ndarray,
+    params: SimParams,
+    adv: AdversaryParams,
+    steps: int,
+    batch_factor: int = 1,
+):
+    """lax.scan of [heartbeat_step -> adversary_round] x steps.
+
+    Unlike run_heartbeats, decay is NOT deferred to scan end and the
+    carried-degree protocol is off: adversary_round writes the penalty
+    counter and the mesh mid-scan, so per-round decay interleaving and the
+    per-step mesh&valid AND are both load-bearing. The alive/subscribed
+    neighbor pull still hoists when churn is off (the attack mutates
+    neither). Returns (state, obs) with obs leaves shaped (steps,)."""
+    nbr_ok = None
+    if params.churn_down_per_hb == 0.0 and params.churn_up_per_hb == 0.0:
+        nbr_ok = neighbor_pull_bool(
+            state.alive & state.subscribed, conns, rev, batch_factor)
+
+    def body(s, _):
+        s = heartbeat_step(s, conns, rev, out_mask, params,
+                           batch_factor=batch_factor, nbr_ok=nbr_ok)
+        s, obs = adversary_round(s, conns, rev, attacker, params, adv,
+                                 batch_factor=batch_factor, nbr_ok=nbr_ok)
+        return s, obs
+
+    return jax.lax.scan(body, state, None, length=steps)
+
+
+def censorship_penalty_update(
+    state: SimState,
+    conns: jnp.ndarray,
+    rev: jnp.ndarray,
+    attacker: jnp.ndarray,
+    received: jnp.ndarray,
+    params: SimParams,
+    adv: AdversaryParams,
+) -> SimState:
+    """Post-publish P3 analog (mesh message delivery failures): a receiver
+    that obtained the message penalizes mesh members that silently delivered
+    none of it. The engine's score subset has no per-edge delivery-window
+    bookkeeping, so the deficit edge set is computed from the adversary
+    ground truth (mesh edges toward censoring attackers) — the EFFECT of P3
+    at the round grain, documented as such in docs/ARCHITECTURE.md."""
+    if float(adv.censor_penalty) == 0.0:
+        return state
+    att_nbr = neighbor_pull_bool(attacker, conns, rev)
+    deficit = (state.mesh_mask & att_nbr
+               & (received & ~attacker)[:, None])
+    return state.replace(slow_penalty=state.slow_penalty + jnp.where(
+        deficit, jnp.float32(adv.censor_penalty), 0.0))

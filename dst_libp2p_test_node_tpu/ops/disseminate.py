@@ -339,6 +339,7 @@ def disseminate(
     loss_edge=None,
     ans_tables=None,
     valid_edge=None,
+    censor_edge=None,
 ):
     """Propagate one application message (all fragments) through the mesh.
 
@@ -508,6 +509,14 @@ def disseminate(
     survive_loss = survive
     if thresholds_can_bind:
         survive = gray_ok if survive is None else survive & gray_ok
+    if censor_edge is not None:
+        # adversarial per-edge DROP mask (ops/adversary.py): an in-mesh
+        # censor silently withholds the copy. Same delivery-only semantics
+        # as the graylist gate — and same exclusion from survive_loss, so
+        # lost_tx keeps counting copies the NETWORK dropped. None (the
+        # default pytree structure) keeps benign traces bit-identical.
+        survive = (~censor_edge if survive is None
+                   else survive & ~censor_edge)
     is_pub = jnp.arange(n) == publisher
     if with_fanout:
         # fanout set: still-valid unexpired members, topped back up to D

@@ -36,6 +36,8 @@ import jax.numpy as jnp
 from flax import struct
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .sharding import shard_map as _shard_map  # jax-version compat resolver
+
 INF = jnp.float32(3.4e38)
 
 PEER_AXIS = "peers"
@@ -238,7 +240,7 @@ def converge_sharded(
             cond, body, (t0_l, jnp.full(src.shape, INF), jnp.bool_(True), 0))
         return t_l, inc_l, ~changed
 
-    fn = jax.shard_map(
+    fn = _shard_map(
         local_fix,
         mesh=mesh,
         in_specs=(rows,) * 11,

@@ -1,0 +1,457 @@
+"""Monte-Carlo adversarial campaigns: the attack workload family.
+
+`run_campaign` sweeps attacker fraction x seed over ONE built network and
+reports resilience metrics per trial. The protocol under test is the v1.1
+score defense the reference ships but no benign workload ever engages
+("GossipSub: Attack-Resilient Message Propagation in the Filecoin and
+ETH2.0 Networks", arXiv:2007.02754); the attacker behaviors live in
+ops/adversary.py as pure on-device masks.
+
+Trial anatomy (one trial = one (fraction, seed) cell):
+
+  setup     attacker cohort drawn host-side (ops/adversary.attacker_cohort),
+            trial PRNG/state re-seeded from the trial seed. The CONNECTION
+            GRAPH is shared across every trial (built once from the
+            experiment seed): the Monte-Carlo axis is protocol randomness +
+            cohort placement, which is what lets the attack window batch.
+  warmup    benign mesh stabilization — except cold_boot_join, where the
+            mesh must FORM during the attack window instead.
+  window    `attack_heartbeats` rounds of [heartbeat_step -> adversary_round]
+            (ops/adversary.run_attacked_heartbeats). When several seeds run
+            the same fraction un-sharded, their windows execute as ONE
+            jax.vmap'd scan over the stacked trial states — the trial batch
+            rides the device, not a Python loop.
+  publish   the experiment's injection schedule. Attackers never usefully
+            forward in ANY scenario (censor_mask folded into disseminate's
+            delivery mask); received-but-undelivered mesh edges accrue the
+            P3-analog penalty (censorship_penalty_update) after each
+            publish, so censors get scored out across the schedule.
+
+Zero-attacker contract: a fraction-0.0 trial takes EXACTLY the benign
+Simulator path — no adversary call, no censor mask (None keeps the publish
+trace's pytree structure), no attack window — so its latencies, byte
+accounting and scores are bit-identical to `Simulator` on the same seed
+(tests/test_adversary.py pins this).
+
+Resilience metrics per trial:
+  honest_coverage      mean delivery fraction over honest peers
+  latency_inflation    honest p50 delay / same-seed benign-baseline p50
+  hb_to_graylist       first window round where >= GRAYLIST_ENGAGED_FRAC of
+                       honest->attacker edges score below graylist_threshold
+                       (compare against the closed-form budget
+                       ops/adversary.heartbeats_to_graylist)
+  mesh_recovery_hb     first window round after peak where the attacker
+                       share of honest mesh edges falls back under
+                       `mesh_recovery_share`
+
+Warm-start/checkpoint reuse: the experiment's `warm_start` flag threads
+through unchanged (the publish schedule warm-starts its fixpoints), and
+`checkpoint_dir` snapshots each trial post-window via runtime/checkpoint.py
+— a crashed sweep resumes per-trial instead of restarting the campaign.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..config.env import GossipSubParams
+from ..ops.adversary import (
+    AdversaryParams,
+    attacker_cohort,
+    censor_mask,
+    censorship_penalty_update,
+    eclipse_setup,
+    heartbeats_to_graylist,
+    run_attacked_heartbeats,
+)
+from .simulator import ExperimentConfig, MessageRecord, Simulator
+
+# an attack "engaged" when this fraction of honest->attacker edges is
+# graylisted (1.0 is the steady state; <1.0 tolerates stragglers whose
+# cohort edge died to churn mid-window)
+GRAYLIST_ENGAGED_FRAC = 0.95
+
+
+def attack_gossipsub(**overrides) -> GossipSubParams:
+    """GossipSub params with the score defense ARMED. The reference default
+    (slow_peer_penalty_weight=0.0) statically compiles every threshold out
+    of the step (`thresholds_can_bind`, ops/state.py) — an attack campaign
+    against that config would measure nothing. These weights give the
+    documented engagement budget of ~7 accrual rounds for unit violations
+    (heartbeats_to_graylist: c_req=5, decay 0.9)."""
+    base = dict(
+        slow_peer_penalty_weight=-10.0,
+        slow_peer_penalty_decay=0.9,
+        gossip_threshold=-10.0,
+        publish_threshold=-20.0,
+        graylist_threshold=-50.0,
+    )
+    base.update(overrides)
+    return GossipSubParams(**base)
+
+
+@dataclass
+class CampaignConfig:
+    scenario: str = "sybil_graft_flood"
+    fractions: tuple = (0.0, 0.1, 0.2)
+    seeds: tuple = (0,)
+    experiment: ExperimentConfig = field(
+        default_factory=lambda: ExperimentConfig(gossipsub=attack_gossipsub()))
+    adversary: AdversaryParams | None = None  # None -> built from scenario
+    # attacked mesh-maintenance rounds between warmup and the first publish
+    attack_heartbeats: int = 20
+    # attacker mesh-share floor that counts as "recovered"
+    mesh_recovery_share: float = 0.05
+    # batch same-fraction trials into one vmapped attack window (un-sharded
+    # runs only; sharded runs go sequential so placement stays row-wise)
+    vmap_trials: bool = True
+    # snapshot each trial's post-window state here (runtime/checkpoint.py)
+    checkpoint_dir: str | None = None
+
+    def adversary_params(self) -> AdversaryParams:
+        return self.adversary or AdversaryParams(scenario=self.scenario)
+
+    def validate(self) -> None:
+        adv = self.adversary_params()
+        adv.validate()
+        if adv.scenario != self.scenario:
+            raise ValueError(
+                f"adversary.scenario {adv.scenario!r} != campaign scenario "
+                f"{self.scenario!r}")
+        if not self.fractions or not self.seeds:
+            raise ValueError("need at least one fraction and one seed")
+        for f in self.fractions:
+            if not (0.0 <= f < 1.0):
+                raise ValueError(f"attacker fraction {f} outside [0, 1)")
+        if self.attack_heartbeats < 1:
+            raise ValueError("attack_heartbeats must be >= 1")
+        if adv.eclipse:
+            if self.experiment.gossipsub.flood_publish:
+                # flood_publish sends to EVERY connected peer regardless of
+                # mesh: the eclipse would be a no-op and the trial would
+                # silently measure nothing
+                raise ValueError(
+                    "eclipse_publisher requires flood_publish=False "
+                    "(flood publish bypasses the eclipsed mesh)")
+            if self.experiment.publisher_rotation:
+                raise ValueError(
+                    "eclipse_publisher targets one publisher; disable "
+                    "publisher_rotation")
+
+
+@dataclass
+class TrialResult:
+    scenario: str
+    fraction: float
+    seed: int
+    attackers: int
+    honest_coverage: float
+    benign_coverage: float
+    latency_p50_ms: float
+    latency_p99_ms: float
+    benign_p50_ms: float
+    latency_inflation: float
+    hb_to_graylist: int          # window round (1-based); -1 = never engaged
+    hb_budget: float             # closed-form documented budget (may be inf)
+    graylisted_frac_final: float
+    mesh_recovery_hb: int        # -1 = not recovered inside the window
+    attacker_mesh_share_final: float
+    attacker_score_final: float
+    wall_s: float
+
+    def to_dict(self) -> dict:
+        d = {}
+        for k, v in self.__dict__.items():
+            if isinstance(v, float) and not math.isfinite(v):
+                v = None  # strict-JSON consumers run allow_nan=False
+            d[k] = v
+        return d
+
+
+@dataclass
+class CampaignResult:
+    scenario: str
+    network_size: int
+    trials: list[TrialResult]
+    hb_budget: float
+    wall_s: float
+
+    @property
+    def trials_per_s(self) -> float:
+        return len(self.trials) / max(self.wall_s, 1e-9)
+
+    def to_dict(self) -> dict:
+        return {
+            "scenario": self.scenario,
+            "network_size": self.network_size,
+            "hb_budget": self.hb_budget if math.isfinite(self.hb_budget) else None,
+            "wall_s": self.wall_s,
+            "trials_per_s": self.trials_per_s,
+            "trials": [t.to_dict() for t in self.trials],
+        }
+
+
+# --------------------------------------------------------------------- trials
+
+
+def _reset_trial(sim: Simulator, seed: int) -> None:
+    """Rewind the shared Simulator onto a trial's seed: state PRNG and msgId
+    stream re-derive from `seed`, the built graph/topology stay the
+    campaign's (Simulator.reset keeps both by design)."""
+    base = sim.cfg.seed
+    sim.cfg.seed = seed
+    try:
+        sim.reset()
+    finally:
+        sim.cfg.seed = base
+
+
+def _publish_schedule(
+    sim: Simulator,
+    censor=None,
+    attacker=None,
+    adv: AdversaryParams | None = None,
+) -> list[MessageRecord]:
+    """The experiment's injection schedule (Simulator.run's loop), with the
+    adversarial delivery mask threaded into every publish and the P3-analog
+    censorship penalty applied after each one."""
+    exp = sim.cfg
+    n = exp.topo.network_size
+    delay_ms = exp.topo.delay_seconds * 1000.0
+    pub = exp.publisher_id % n
+    a = sim.arrays
+    for i in range(exp.topo.messages):
+        if i > 0:
+            sim.advance(delay_ms)
+        rec = sim.publish(pub, censor_edge=censor)
+        if censor is not None:
+            import jax.numpy as jnp
+
+            sim.state = censorship_penalty_update(
+                sim.state, a["conns"], a["rev"], attacker,
+                jnp.asarray(rec.received), sim.params, adv)
+        if exp.publisher_rotation:
+            pub = (pub + 1) % n
+    return sim.records
+
+
+def _delivery_metrics(records: list[MessageRecord], honest: np.ndarray):
+    """(coverage, p50_ms, p99_ms) over honest peers, pooled across the
+    schedule. Empty delivery pools report inf latencies (to_dict nulls
+    them for strict-JSON consumers)."""
+    if not records:
+        return 0.0, math.inf, math.inf
+    cov = float(np.mean([r.received[honest].mean() for r in records]))
+    pool = np.concatenate(
+        [r.delays_ms[honest & r.received] for r in records])
+    if pool.size == 0:
+        return cov, math.inf, math.inf
+    return (cov, float(np.percentile(pool, 50)), float(np.percentile(pool, 99)))
+
+
+def _ensure_baseline(sim: Simulator, cache: dict, seed: int) -> dict:
+    """Benign metrics for `seed` (the fraction-0.0 path), computed at most
+    once per seed per campaign."""
+    if seed not in cache:
+        _reset_trial(sim, seed)
+        sim.warmup()
+        records = _publish_schedule(sim)
+        honest = np.ones(sim.params.n, dtype=bool)
+        cov, p50, p99 = _delivery_metrics(records, honest)
+        cache[seed] = {"coverage": cov, "p50": p50, "p99": p99}
+    return cache[seed]
+
+
+def _benign_trial(sim: Simulator, cfg: CampaignConfig, seed: int,
+                  cache: dict, budget: float) -> TrialResult:
+    t0 = time.time()
+    cache.pop(seed, None)  # force the run (the trial IS the baseline)
+    base = _ensure_baseline(sim, cache, seed)
+    return TrialResult(
+        scenario=cfg.scenario, fraction=0.0, seed=seed, attackers=0,
+        honest_coverage=base["coverage"], benign_coverage=base["coverage"],
+        latency_p50_ms=base["p50"], latency_p99_ms=base["p99"],
+        benign_p50_ms=base["p50"], latency_inflation=1.0,
+        hb_to_graylist=-1, hb_budget=budget,
+        graylisted_frac_final=0.0, mesh_recovery_hb=-1,
+        attacker_mesh_share_final=0.0, attacker_score_final=0.0,
+        wall_s=time.time() - t0,
+    )
+
+
+def _first_round(curve: np.ndarray, pred) -> int:
+    """1-based index of the first round satisfying pred, -1 if none."""
+    hits = np.nonzero(pred(curve))[0]
+    return int(hits[0]) + 1 if hits.size else -1
+
+
+def _obs_metrics(obs: dict, share_floor: float):
+    gf = np.asarray(obs["graylisted_frac"], dtype=np.float64)
+    share = np.asarray(obs["attacker_mesh_share"], dtype=np.float64)
+    engaged = _first_round(gf, lambda c: c >= GRAYLIST_ENGAGED_FRAC)
+    peak = int(np.argmax(share))
+    if share.max() <= share_floor:
+        recovery = 1  # never meaningfully compromised
+    else:
+        after = share[peak:]
+        rel = _first_round(after, lambda c: c <= share_floor)
+        recovery = peak + rel if rel > 0 else -1
+    return engaged, float(gf[-1]), recovery, float(share[-1])
+
+
+def _attack_windows(sim: Simulator, attackers, states, adv, steps: int):
+    """Run the attack window for a batch of trials. Un-sharded multi-trial
+    batches stack onto one vmapped scan (the fraction's whole seed column in
+    one device program); sharded or single trials run the plain jit."""
+    import jax
+    import jax.numpy as jnp
+
+    tree = jax.tree_util.tree_map
+    a = sim.arrays
+    if len(states) == 1:
+        st, obs = run_attacked_heartbeats(
+            states[0], a["conns"], a["rev"], a["out_mask"], attackers[0],
+            sim.params, adv, steps)
+        return [st], [tree(np.asarray, obs)]
+    s_count = len(states)
+    stacked = tree(lambda *xs: jnp.stack(xs), *states)
+    att = jnp.stack(attackers)
+
+    def one(st, at):
+        return run_attacked_heartbeats(
+            st, a["conns"], a["rev"], a["out_mask"], at, sim.params, adv,
+            steps, batch_factor=s_count)
+
+    out_states, obs = jax.vmap(one)(stacked, att)
+    obs_np = tree(np.asarray, obs)
+    return (
+        [tree(lambda x, j=j: x[j], out_states) for j in range(s_count)],
+        [{k: v[j] for k, v in obs_np.items()} for j in range(s_count)],
+    )
+
+
+def _attacked_trials(
+    sim: Simulator,
+    cfg: CampaignConfig,
+    fraction: float,
+    seeds: list[int],
+    cache: dict,
+    budget: float,
+) -> list[TrialResult]:
+    import jax.numpy as jnp
+
+    adv = cfg.adversary_params()
+    exp = cfg.experiment
+    n = sim.params.n
+    conns_np = np.asarray(sim.graph.conns)
+    pub = exp.publisher_id % n
+    hb_ms = sim.params.heartbeat_ms
+    warm_steps = int(exp.warmup_s * 1000.0 // hb_ms)
+    # cold boot joins the network mid-attack: the warmup rounds RUN INSIDE
+    # the window (mesh formation under fire), not before it
+    steps = cfg.attack_heartbeats + (warm_steps if adv.cold_boot else 0)
+
+    t0 = time.time()
+    cohorts, states = [], []
+    for s in seeds:
+        att = attacker_cohort(n, fraction, seed=s, conns=conns_np,
+                              publisher=pub, eclipse=adv.eclipse)
+        _reset_trial(sim, s)
+        if not adv.cold_boot:
+            sim.warmup()
+        att_j = jnp.asarray(att)
+        if adv.eclipse:
+            sim.state = eclipse_setup(sim.state, sim.arrays["conns"],
+                                      att_j, pub)
+        cohorts.append((att, att_j))
+        states.append(sim.state)
+
+    states, obs_list = _attack_windows(
+        sim, [aj for _, aj in cohorts], states, adv, steps)
+
+    out = []
+    for j, s in enumerate(seeds):
+        att, att_j = cohorts[j]
+        base = _ensure_baseline(sim, cache, s)
+        _reset_trial(sim, s)
+        sim.state = states[j]
+        if cfg.checkpoint_dir:
+            from .checkpoint import save_checkpoint
+
+            os.makedirs(cfg.checkpoint_dir, exist_ok=True)
+            save_checkpoint(sim, os.path.join(
+                cfg.checkpoint_dir,
+                f"{cfg.scenario}_f{fraction:g}_s{s}.npz"))
+        censor = censor_mask(att_j, sim.arrays["conns"])
+        records = _publish_schedule(sim, censor=censor, attacker=att_j,
+                                    adv=adv)
+        honest = ~att
+        cov, p50, p99 = _delivery_metrics(records, honest)
+        engaged, gf_final, recovery, share_final = _obs_metrics(
+            obs_list[j], cfg.mesh_recovery_share)
+        # final honest-side view of attacker edges (post-publish: includes
+        # the censorship penalties the window could not see)
+        sc = np.asarray(sim.state.score(sim.params), dtype=np.float64)
+        att_edge = (conns_np >= 0) & att[np.clip(conns_np, 0, None)]
+        h_att = att_edge & honest[:, None]
+        score_final = float(sc[h_att].mean()) if h_att.any() else 0.0
+        out.append(TrialResult(
+            scenario=cfg.scenario, fraction=fraction, seed=s,
+            attackers=int(att.sum()),
+            honest_coverage=cov, benign_coverage=base["coverage"],
+            latency_p50_ms=p50, latency_p99_ms=p99,
+            benign_p50_ms=base["p50"],
+            latency_inflation=(p50 / base["p50"]
+                               if base["p50"] > 0 and math.isfinite(p50)
+                               else math.inf),
+            hb_to_graylist=engaged, hb_budget=budget,
+            graylisted_frac_final=gf_final, mesh_recovery_hb=recovery,
+            attacker_mesh_share_final=share_final,
+            attacker_score_final=score_final,
+            wall_s=(time.time() - t0) / len(seeds),
+        ))
+    return out
+
+
+def run_campaign(cfg: CampaignConfig, mesh=None) -> CampaignResult:
+    """Execute the sweep: every (fraction, seed) cell of the campaign grid.
+    `mesh`: optional 1-D jax.sharding.Mesh over the peer axis, threaded to
+    the Simulator (row-sharded state + shard_map dissemination); sharded
+    runs keep trials sequential so placement stays row-wise."""
+    cfg.validate()
+    adv = cfg.adversary_params()
+    t0 = time.time()
+    sim = Simulator(cfg.experiment, mesh=mesh)
+    budget = heartbeats_to_graylist(adv, sim.params)
+    if (adv.graft_flood or adv.ihave_spam) and any(
+            f > 0 for f in cfg.fractions) and math.isinf(budget):
+        raise ValueError(
+            "score defense cannot engage under this config "
+            "(heartbeats_to_graylist is inf): raise |slow_peer_penalty_weight|"
+            ", lower |graylist_threshold|, or raise the penalty/decay — "
+            "attack_gossipsub() is the armed default")
+    cache: dict[int, dict] = {}
+    trials: list[TrialResult] = []
+    for f in cfg.fractions:
+        seeds = list(cfg.seeds)
+        if f == 0.0:
+            for s in seeds:
+                trials.append(_benign_trial(sim, cfg, s, cache, budget))
+        elif cfg.vmap_trials and len(seeds) > 1 and mesh is None:
+            trials.extend(_attacked_trials(sim, cfg, f, seeds, cache, budget))
+        else:
+            for s in seeds:
+                trials.extend(
+                    _attacked_trials(sim, cfg, f, [s], cache, budget))
+    return CampaignResult(
+        scenario=cfg.scenario,
+        network_size=sim.params.n,
+        trials=trials,
+        hb_budget=budget,
+        wall_s=time.time() - t0,
+    )

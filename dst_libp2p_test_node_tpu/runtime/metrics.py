@@ -404,3 +404,70 @@ class NodeMetrics:
 
     def render(self) -> str:
         return self.registry.render()
+
+
+class CampaignMetrics:
+    """Prometheus series for adversarial campaigns (runtime/campaign.py).
+
+    One labeled sample per (scenario, fraction, seed) trial cell, named in
+    the dst_testnode_* family so the existing scrape/dashboard plumbing
+    picks the attack sweeps up unchanged. Gauges carry the resilience
+    metrics; non-finite values (no honest delivery -> inf latency) are
+    SKIPPED rather than exported — Prometheus text exposition has no null
+    and an +Inf gauge poisons every aggregation over the series."""
+
+    _LABELS = ("scenario", "fraction", "seed")
+
+    def __init__(self, registry: Registry | None = None):
+        self.registry = registry or Registry()
+        r = self.registry
+        lab = self._LABELS
+        self.trials = r.counter(
+            "dst_testnode_attack_trials_total",
+            "number of completed adversarial campaign trials", ("scenario",))
+        self.coverage = r.gauge(
+            "dst_testnode_attack_honest_coverage",
+            "honest-peer delivery coverage under attack", lab)
+        self.inflation = r.gauge(
+            "dst_testnode_attack_latency_inflation",
+            "honest p50 delay over the same-seed benign baseline", lab)
+        self.hb_to_graylist = r.gauge(
+            "dst_testnode_attack_heartbeats_to_graylist",
+            "heartbeats until the graylist defense engaged (-1 = never)", lab)
+        self.mesh_recovery = r.gauge(
+            "dst_testnode_attack_mesh_recovery_heartbeats",
+            "heartbeats until attacker mesh share fell back under the "
+            "recovery floor (-1 = not inside the window)", lab)
+        self.attacker_score = r.gauge(
+            "dst_testnode_attack_attacker_score",
+            "mean honest-side score of attacker edges after the schedule",
+            lab)
+        self.mesh_share = r.gauge(
+            "dst_testnode_attack_attacker_mesh_share",
+            "attacker share of honest mesh edges after the attack window",
+            lab)
+
+    def fill_from_campaign(self, campaign: dict) -> None:
+        """Project a CampaignResult.to_dict onto the series (duck-typed on
+        the dict, like summarize.report_campaign)."""
+        import math
+
+        for t in campaign["trials"]:
+            self.trials.inc(labels={"scenario": t["scenario"]})
+            labels = {"scenario": t["scenario"],
+                      "fraction": f"{t['fraction']:g}",
+                      "seed": str(t["seed"])}
+            for series, key in (
+                (self.coverage, "honest_coverage"),
+                (self.inflation, "latency_inflation"),
+                (self.hb_to_graylist, "hb_to_graylist"),
+                (self.mesh_recovery, "mesh_recovery_hb"),
+                (self.attacker_score, "attacker_score_final"),
+                (self.mesh_share, "attacker_mesh_share_final"),
+            ):
+                v = t.get(key)
+                if v is not None and math.isfinite(float(v)):
+                    series.set(float(v), labels=labels)
+
+    def render(self) -> str:
+        return self.registry.render()

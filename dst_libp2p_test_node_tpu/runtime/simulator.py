@@ -387,8 +387,18 @@ class Simulator:
     def warmup(self) -> None:
         self.advance(self.cfg.warmup_s * 1000.0)
 
-    def publish(self, publisher: int, msg_size: int | None = None) -> MessageRecord:
-        """Inject one message at the current sim time (the /publish path)."""
+    def publish(
+        self,
+        publisher: int,
+        msg_size: int | None = None,
+        censor_edge=None,
+    ) -> MessageRecord:
+        """Inject one message at the current sim time (the /publish path).
+
+        `censor_edge`: optional (N, C) adversarial per-edge delivery drop
+        mask (ops/adversary.py censor_mask) threaded to disseminate; None
+        (the default) keeps the benign publish trace bit-identical — the
+        zero-attacker campaign contract (runtime/campaign.py)."""
         cfg = self.cfg
         size = msg_size if msg_size is not None else cfg.topo.msg_size_bytes
         a = self.arrays
@@ -466,6 +476,7 @@ class Simulator:
             loss_edge=self._loss_edge,
             ans_tables=self._ans_tables,
             valid_edge=self._valid_edge,
+            censor_edge=censor_edge,
             # unsubscribed publisher -> gossipsub v1.1 fanout publish
             with_fanout=not bool(self._subscribed_np[publisher]),
         )

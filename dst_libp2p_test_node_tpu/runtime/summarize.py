@@ -150,3 +150,38 @@ def report(s: LatencySummary, large: bool = False) -> str:
 def summarize_file(path: str, large: bool = False) -> LatencySummary:
     with open(path) as f:
         return summarize(f, large=large)
+
+
+def _cell(v, fmt: str = "g") -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return format(v, fmt)
+    return str(v)
+
+
+def report_campaign(campaign: dict) -> str:
+    """Text report for an adversarial campaign (runtime/campaign.py
+    CampaignResult.to_dict). Duck-typed on the dict so `summarize`-side
+    tooling needs no import of the campaign module (and a JSON artifact
+    reloads straight into this)."""
+    hdr = (f"Attack campaign :  {campaign['scenario']}  Peers :  "
+           f"{campaign['network_size']}  Graylist budget (hb) :  "
+           f"{_cell(campaign.get('hb_budget'))}")
+    cols = ("frac \t seed \t attackers \t coverage \t p50_ms \t inflation "
+            "\t hb_gray \t recover_hb \t att_score")
+    out = [hdr, cols]
+    for t in campaign["trials"]:
+        out.append(" \t ".join([
+            _cell(t["fraction"]), str(t["seed"]), str(t["attackers"]),
+            _cell(t["honest_coverage"], ".4f"),
+            _cell(t["latency_p50_ms"], ".1f"),
+            _cell(t["latency_inflation"], ".3f"),
+            str(t["hb_to_graylist"]), str(t["mesh_recovery_hb"]),
+            _cell(t["attacker_score_final"], ".1f"),
+        ]))
+    out.append(
+        f"Trials :  {len(campaign['trials'])}  trials/s :  "
+        f"{_cell(campaign.get('trials_per_s'), '.3f')}  wall :  "
+        f"{_cell(campaign.get('wall_s'), '.2f')} s")
+    return "\n".join(out) + "\n"

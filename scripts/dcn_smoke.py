@@ -87,7 +87,9 @@ def worker(process_id: int) -> None:
     def body(x):
         return jax.lax.psum(x.sum(), "peers") * jnp.ones_like(x)
 
-    out = jax.jit(jax.shard_map(
+    from dst_libp2p_test_node_tpu.parallel.sharding import shard_map
+
+    out = jax.jit(shard_map(
         body, mesh=mesh, in_specs=P("peers"), out_specs=P("peers")))(arr)
     # every element is the GLOBAL sum — proof the collective crossed the
     # process boundary (reading this process's local shard suffices)

@@ -1,0 +1,185 @@
+"""Adversarial campaign tests (ops/adversary.py + runtime/campaign.py).
+
+Pins the two PR acceptance properties: a zero-attacker campaign trial is
+bit-identical to the plain Simulator on the same seed, and the sybil
+graft-flood engages the graylist within the closed-form
+heartbeats_to_graylist budget without collapsing honest coverage.
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+from dst_libp2p_test_node_tpu.config.topology import TopoParams
+from dst_libp2p_test_node_tpu.ops.adversary import (
+    SCENARIOS,
+    AdversaryParams,
+    attacker_cohort,
+    censor_mask,
+    heartbeats_to_graylist,
+)
+from dst_libp2p_test_node_tpu.ops.state import SimParams
+from dst_libp2p_test_node_tpu.runtime import campaign as camp
+from dst_libp2p_test_node_tpu.runtime.campaign import (
+    GRAYLIST_ENGAGED_FRAC,
+    CampaignConfig,
+    attack_gossipsub,
+    run_campaign,
+)
+from dst_libp2p_test_node_tpu.runtime.simulator import (
+    ExperimentConfig,
+    Simulator,
+)
+
+
+def _exp(n=64, seed=0, messages=2, warmup_s=8.0, **gs):
+    """Small armed experiment; every tier-1 test shares this shape so the
+    jitted step/fixpoint traces are reused across the module."""
+    return ExperimentConfig(
+        topo=TopoParams(network_size=n, anchor_stages=2, min_bandwidth=50,
+                        max_bandwidth=150, min_latency=40, max_latency=130,
+                        msg_size_bytes=2000, messages=messages,
+                        delay_seconds=1.0),
+        connect_to=8, gossipsub=attack_gossipsub(**gs), warmup_s=warmup_s,
+        seed=seed)
+
+
+def test_zero_attacker_campaign_is_bit_identical_to_simulator():
+    plain = Simulator(_exp(seed=3))
+    plain_records = plain.run()
+
+    sim = Simulator(_exp(seed=3))
+    camp._reset_trial(sim, 3)
+    sim.warmup()
+    records = camp._publish_schedule(sim)  # censor=None: the benign trace
+
+    assert len(records) == len(plain_records) > 0
+    for rp, rc in zip(plain_records, records):
+        assert rp.msg_id == rc.msg_id
+        np.testing.assert_array_equal(rp.delays_ms, rc.delays_ms)
+        np.testing.assert_array_equal(rp.received, rc.received)
+    # device state bit-identity, not just delivery metrics: scores, byte
+    # accounting and the clock all took the same path
+    for leaf in ("fmd", "slow_penalty", "bytes_tx", "bytes_rx", "t_ms"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(plain.state, leaf)),
+            np.asarray(getattr(sim.state, leaf)), err_msg=leaf)
+
+    # and through run_campaign's fraction-0.0 path: metrics are exactly the
+    # plain run's (no tolerance — same floats or the contract is broken)
+    res = run_campaign(CampaignConfig(
+        scenario="sybil_graft_flood", fractions=(0.0,), seeds=(3,),
+        experiment=_exp(seed=3), attack_heartbeats=2))
+    t = res.trials[0]
+    pool = np.concatenate([r.delays_ms[r.received] for r in plain_records])
+    assert t.latency_p50_ms == float(np.percentile(pool, 50))
+    assert t.honest_coverage == float(
+        np.mean([r.received.mean() for r in plain_records]))
+    assert t.latency_inflation == 1.0 and t.attackers == 0
+
+
+def test_sybil_graft_flood_engages_within_budget():
+    cfg = CampaignConfig(
+        scenario="sybil_graft_flood", fractions=(0.0, 0.15), seeds=(0, 1),
+        experiment=_exp(seed=0), attack_heartbeats=12)
+    res = run_campaign(cfg)
+    budget = res.hb_budget
+    assert math.isfinite(budget)
+    attacked = [t for t in res.trials if t.fraction > 0]
+    assert len(attacked) == 2  # two seeds -> the vmapped window path
+    for t in attacked:
+        assert t.attackers > 0
+        # defense engages within the documented closed-form budget
+        assert 0 < t.hb_to_graylist <= budget
+        assert t.graylisted_frac_final >= GRAYLIST_ENGAGED_FRAC
+        assert (t.attacker_score_final
+                < cfg.experiment.gossipsub.graylist_threshold)
+        # and the attack does not collapse honest delivery
+        assert t.honest_coverage >= t.benign_coverage - 0.02
+
+
+@pytest.mark.parametrize("scenario,w,d,G,p", [
+    ("sybil_graft_flood", -10.0, 0.9, -50.0, 1.0),
+    ("ihave_spam", -10.0, 0.9, -50.0, 1.0),   # lead-in 1, not 2
+    ("sybil_graft_flood", -5.0, 0.8, -40.0, 2.0),
+    ("sybil_graft_flood", -1.0, 0.5, -100.0, 1.0),  # unreachable -> inf
+])
+def test_graylist_budget_matches_recurrence(scenario, w, d, G, p):
+    adv = AdversaryParams(scenario=scenario, violation_penalty=p)
+    params = SimParams(n=16, capacity=8, slow_weight=w, slow_decay=d,
+                       graylist_threshold=G)
+    budget = heartbeats_to_graylist(adv, params)
+
+    # brute-force the counter recurrence c_k = d*c_{k-1} + p, accrual
+    # starting on the scenario's lead-in round
+    lead_in = 1 if scenario == "ihave_spam" else 2
+    c, measured = 0.0, math.inf
+    for k in range(1, 500):
+        c = c * d + (p if k >= lead_in else 0.0)
+        if w * c <= G:
+            measured = k
+            break
+    assert budget == measured
+
+
+def test_budget_inf_when_defense_disarmed():
+    adv = AdversaryParams()
+    params = SimParams(n=16, capacity=8)  # slow_weight=0: compiled out
+    assert math.isinf(heartbeats_to_graylist(adv, params))
+
+
+def test_censor_mask_covers_attacker_out_edges_only():
+    import jax.numpy as jnp
+
+    conns = jnp.asarray([[1, 2, -1], [0, 2, -1], [0, 1, -1]])
+    att = jnp.asarray([False, True, False])
+    m = np.asarray(censor_mask(att, conns))
+    assert m[1].tolist() == [True, True, False]  # padded slot stays clear
+    assert not m[0].any() and not m[2].any()
+
+
+def test_attacker_cohort_deterministic_and_eclipse_prefers_neighbors():
+    a1 = attacker_cohort(64, 0.25, seed=7)
+    a2 = attacker_cohort(64, 0.25, seed=7)
+    np.testing.assert_array_equal(a1, a2)
+    assert a1.sum() == 16
+
+    conns = np.full((64, 4), -1)
+    conns[5] = [1, 2, 3, 4]
+    ecl = attacker_cohort(64, 0.1, seed=7, conns=conns, publisher=5,
+                          eclipse=True)
+    assert ecl[[1, 2, 3, 4]].all()   # victim's slots filled first
+    assert not ecl[5]                # the publisher is never an attacker
+    assert ecl.sum() == 6            # round(0.1 * 64), rest drawn at random
+
+
+def test_campaign_validation():
+    with pytest.raises(ValueError, match="unknown scenario"):
+        AdversaryParams(scenario="nope").validate()
+    # eclipse against flood_publish would silently measure nothing
+    with pytest.raises(ValueError, match="flood_publish"):
+        CampaignConfig(scenario="eclipse_publisher",
+                       experiment=_exp()).validate()
+    # a disarmed score surface must fail loudly, not sweep forever
+    with pytest.raises(ValueError, match="cannot engage"):
+        run_campaign(CampaignConfig(
+            scenario="sybil_graft_flood", fractions=(0.1,), seeds=(0,),
+            experiment=_exp(slow_peer_penalty_weight=0.0)))
+
+
+@pytest.mark.slow
+def test_all_scenarios_run_end_to_end():
+    # every scenario through the full campaign path at a shape where the
+    # eclipse cohort stays below the publisher degree (partial eclipse)
+    for scen in SCENARIOS:
+        exp = _exp(n=256, seed=0,
+                   flood_publish=(scen != "eclipse_publisher"))
+        res = run_campaign(CampaignConfig(
+            scenario=scen, fractions=(0.04,), seeds=(0,), experiment=exp,
+            attack_heartbeats=10))
+        t = res.trials[0]
+        assert t.attackers > 0
+        assert 0.0 <= t.honest_coverage <= 1.0
+        if scen in ("sybil_graft_flood", "ihave_spam", "cold_boot_join"):
+            assert 0 < t.hb_to_graylist <= res.hb_budget
