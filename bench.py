@@ -378,8 +378,13 @@ def main() -> None:
             "p99_ms": float(np.percentile(delays[ok], 99)),
         },
     }
-    # strict JSON: refuse NaN/Infinity outright (json.dump would emit the
-    # invalid-JSON literal Infinity and downstream parsers choke)
+    # strict JSON: the shared sanitizer nulls any non-finite float that
+    # slipped past the sanity gates above, and allow_nan=False stays on as
+    # the hard backstop (json.dump would otherwise emit the invalid-JSON
+    # literal Infinity and downstream parsers choke)
+    from dst_libp2p_test_node_tpu.runtime.summarize import sanitize_nonfinite
+
+    out = sanitize_nonfinite(out)
     print(json.dumps(out, allow_nan=False))
 
 

@@ -57,7 +57,7 @@ def _emit(config: int, n: int, wall: float, rounds: float, delays, extra=None):
     }
     if extra:
         out.update(extra)
-    print(json.dumps(out), flush=True)
+    print(json.dumps(out, allow_nan=False), flush=True)
     return out
 
 
@@ -360,7 +360,7 @@ def main():
             # README config table is pinned to the artifact's rows
             for r in results:
                 if r["config"] != 6:
-                    fh.write(json.dumps(r) + "\n")
+                    fh.write(json.dumps(r, allow_nan=False) + "\n")
     if failures:
         sys.exit(1)
 

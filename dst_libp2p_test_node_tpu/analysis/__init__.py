@@ -1,0 +1,25 @@
+"""graft-audit: static analysis + contracts for the jitted hot paths.
+
+Two engines over one violation model (analysis/report.py):
+
+  - jaxpr auditor (analysis/jaxpr_audit.py): abstractly traces every
+    registered entrypoint (analysis/registry.py) and enforces loop/carry/
+    cond/donation/compile-key contracts — GA-J*.
+  - AST lint (analysis/ast_lint.py): source-level rules over the package's
+    jitted scopes and artifact writers — GA-A*.
+
+CLI: ``python -m dst_libp2p_test_node_tpu lint`` (strict-JSON report,
+nonzero exit on findings). Tier-1 gate: tests/test_graft_audit.py asserts
+the repo audits clean.
+"""
+
+from .ast_lint import lint_paths, lint_source
+from .contracts import EntrypointContract, LadderRung, TraceSpec
+from .jaxpr_audit import audit_contract, audit_contracts, run_checkify
+from .report import RULES, Violation, render_report
+
+__all__ = [
+    "EntrypointContract", "LadderRung", "TraceSpec", "Violation", "RULES",
+    "audit_contract", "audit_contracts", "run_checkify",
+    "lint_paths", "lint_source", "render_report",
+]

@@ -1,0 +1,468 @@
+"""Engine 2 — repo-specific AST lint over the jitted hot paths.
+
+Static source analysis, no imports of the linted modules: every rule works
+on the parse tree alone, so the linter runs in milliseconds and is safe on
+files whose import would cost a device or a trace.
+
+The core is a light taint analysis per *traced scope* (a function wrapped in
+``jax.jit`` / ``partial(jax.jit, static_argnames=...)``, plus every function
+nested inside one — nested defs are the scan/while/cond bodies and fragment
+lambdas, which receive tracers). Parameters not named in ``static_argnames``
+are tainted; taint propagates through assignment, arithmetic, calls and
+subscripts, and is *neutralized* by the aval-reading attributes
+(``.shape``/``.ndim``/``.dtype``/``.size``) and by ``len()``/``isinstance()``
+— those yield Python values under tracing, so branching on them is fine.
+
+Inner-function parameters are resolved by CALL-SITE propagation, not blanket
+tainting: the linter runs optimistic collect passes to a fixpoint (a param
+is tainted only if some call site actually passes it a tainted value, or
+the function is passed as a value to ``lax.scan``/``while_loop``/``cond``/
+``vmap`` — whose calls supply tracers), then a final report pass. This is
+what lets `phases_fast(f, t, warm)`-style static mode flags thread through
+helpers without false `if warm:` findings.
+
+Rules (ids in analysis/report.py):
+  GA-A001  np.*/math.* applied to a tainted value (host math on a tracer)
+  GA-A002  float()/int()/bool() applied to a tainted value (host coercion —
+           a TracerBoolConversionError at trace time, or worse, a silent
+           constant if the value was accidentally concrete)
+  GA-A003  `if`/`while`/ternary whose test is tainted (Python control flow
+           on a tracer; the vmapped form silently executes both branches)
+  GA-A004  `.item()`/`.block_until_ready()`/`jax.device_get` on a tainted
+           value inside a traced scope (host sync under trace)
+  GA-A005  json.dump/json.dumps without allow_nan=False and without routing
+           through runtime.summarize.sanitize_nonfinite() — non-finite
+           floats would poison the strict-JSON artifact chain. Applies to
+           whole files, not just traced scopes.
+
+A line ending in ``# graft-audit: ok`` waives any rule on that line.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from .report import Violation, suppressed_lines
+
+# attributes whose read yields static Python data even on a tracer
+_NEUTRAL_ATTRS = {"shape", "ndim", "dtype", "size", "aval", "sharding"}
+# calls that return static Python data regardless of argument taint
+_NEUTRAL_CALLS = {"len", "isinstance", "type", "hasattr", "callable", "id",
+                  "repr", "str", "format"}
+_COERCIONS = {"float", "int", "bool", "complex"}
+_HOST_MATH_MODULES = {"np", "numpy", "math"}
+_HOST_SYNC_ATTRS = {"item", "block_until_ready"}
+_MAX_FIXPOINT_PASSES = 10
+
+
+def _is_jax_jit(node: ast.expr) -> bool:
+    """jax.jit / jit as a bare decorator or partial() first argument."""
+    if isinstance(node, ast.Name) and node.id == "jit":
+        return True
+    return (isinstance(node, ast.Attribute) and node.attr == "jit"
+            and isinstance(node.value, ast.Name)
+            and node.value.id in ("jax", "pjit"))
+
+
+def _static_argnames_from_call(call: ast.Call) -> set[str]:
+    names: set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            v = kw.value
+            elts = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+            for e in elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                    names.add(e.value)
+    return names
+
+
+def _traced_decoration(fn: ast.FunctionDef) -> set[str] | None:
+    """None if not jit-decorated, else the set of static argument names."""
+    for dec in fn.decorator_list:
+        if _is_jax_jit(dec):
+            return set()
+        if isinstance(dec, ast.Call):
+            if _is_jax_jit(dec.func):
+                return _static_argnames_from_call(dec)
+            # partial(jax.jit, static_argnames=(...)) / functools.partial
+            f = dec.func
+            is_partial = (isinstance(f, ast.Name) and f.id == "partial") or (
+                isinstance(f, ast.Attribute) and f.attr == "partial")
+            if is_partial and dec.args and _is_jax_jit(dec.args[0]):
+                return _static_argnames_from_call(dec)
+    return None
+
+
+def _param_names(fn) -> list[str]:
+    a = fn.args
+    names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return names
+
+
+class _ScopeLinter:
+    """Fixpoint taint walk over one traced scope and its nested functions.
+
+    Collect passes (report=False) only accumulate per-parameter taint for
+    inner defs from their call sites; the final report pass emits
+    violations using the converged parameter taint.
+    """
+
+    def __init__(self, path: str, suppressed: set[int],
+                 violations: list[Violation]):
+        self.path = path
+        self.suppressed = suppressed
+        self.violations = violations
+        # (id(FunctionDef), param name) -> tainted at some call site
+        self.param_taint: dict[tuple[int, str], bool] = {}
+        # FunctionDef ids passed as values (loop/branch bodies): all params
+        # receive tracers
+        self.forced: set[int] = set()
+        self.report = False
+        self.changed = False
+
+    def lint_scope(self, fn: ast.FunctionDef, static: set[str]) -> None:
+        for _ in range(_MAX_FIXPOINT_PASSES):
+            self.changed = False
+            self.report = False
+            self._run(fn, static)
+            if not self.changed:
+                break
+        self.report = True
+        self._run(fn, static)
+
+    def _run(self, fn: ast.FunctionDef, static: set[str]) -> None:
+        taint = set(_param_names(fn)) - static
+        self._lint_function_body(fn, taint, static, {})
+
+    # ---------------------------------------------------------------- taint
+
+    def tainted(self, node: ast.expr, taint: set[str]) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in taint
+        if isinstance(node, ast.Constant):
+            return False
+        if isinstance(node, ast.Attribute):
+            if node.attr in _NEUTRAL_ATTRS:
+                return False
+            return self.tainted(node.value, taint)
+        if isinstance(node, ast.Compare):
+            # `x is None` / `x is not None` is a static structural check
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+                return False
+            return (self.tainted(node.left, taint)
+                    or any(self.tainted(c, taint) for c in node.comparators))
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Name) and f.id in _NEUTRAL_CALLS:
+                return False
+            parts = [] if isinstance(f, ast.Name) else [f]
+            parts += list(node.args)
+            parts += [kw.value for kw in node.keywords]
+            return any(self.tainted(p, taint) for p in parts)
+        if isinstance(node, ast.Lambda):
+            return False  # a function value; its body is traced on purpose
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                             ast.GeneratorExp)):
+            # comprehension over tracers: taint if any free Name is tainted
+            return any(isinstance(n, ast.Name) and n.id in taint
+                       for n in ast.walk(node))
+        # generic: any tainted child expression taints the parent
+        return any(self.tainted(c, taint)
+                   for c in ast.iter_child_nodes(node)
+                   if isinstance(c, ast.expr))
+
+    # ------------------------------------------------------------ reporting
+
+    def _flag(self, rule: str, node: ast.AST, message: str) -> None:
+        if not self.report:
+            return
+        line = getattr(node, "lineno", 0)
+        if line in self.suppressed:
+            return
+        self.violations.append(
+            Violation(rule=rule, file=self.path, line=line, message=message))
+
+    # -------------------------------------------------- inner-def resolution
+
+    def _record_param(self, target: ast.FunctionDef, name: str,
+                      is_tainted: bool) -> None:
+        key = (id(target), name)
+        prev = self.param_taint.get(key, False)
+        if is_tainted and not prev:
+            self.param_taint[key] = True
+            self.changed = True
+        elif key not in self.param_taint:
+            self.param_taint[key] = prev
+
+    def _force(self, target: ast.FunctionDef) -> None:
+        if id(target) not in self.forced:
+            self.forced.add(id(target))
+            self.changed = True
+
+    def _inner_taint(self, fn: ast.FunctionDef, closure_taint: set[str],
+                     env: dict) -> set[str]:
+        params = _param_names(fn)
+        if id(fn) in self.forced:
+            tainted_params = set(params)
+        else:
+            tainted_params = {p for p in params
+                              if self.param_taint.get((id(fn), p), False)}
+        return (closure_taint - set(params)) | tainted_params
+
+    def _lint_function_body(self, fn, taint: set[str], static: set[str],
+                            env: dict) -> None:
+        env = dict(env)
+        # hoist sibling defs first: bodies may forward-reference them
+        for stmt in fn.body:
+            if isinstance(stmt, ast.FunctionDef):
+                env[stmt.name] = stmt
+        self._lint_body(fn.body, taint, static, env)
+
+    # ---------------------------------------------------------- statements
+
+    def _lint_body(self, body, taint, static, env) -> None:
+        for stmt in body:
+            self._lint_stmt(stmt, taint, static, env)
+
+    def _assign_target(self, target: ast.expr, taint: set[str],
+                       value_tainted: bool) -> None:
+        if isinstance(target, ast.Name):
+            if value_tainted:
+                taint.add(target.id)
+            else:
+                taint.discard(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self._assign_target(e, taint, value_tainted)
+        elif isinstance(target, ast.Starred):
+            self._assign_target(target.value, taint, value_tainted)
+
+    def _lint_stmt(self, stmt, taint, static, env) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            inner = self._inner_taint(stmt, taint, env)
+            self._lint_function_body(stmt, inner, static, env)
+            return
+        if isinstance(stmt, ast.Assign):
+            self._scan_expr(stmt.value, taint, env)
+            vt = self.tainted(stmt.value, taint)
+            for t in stmt.targets:
+                self._assign_target(t, taint, vt)
+            return
+        if isinstance(stmt, ast.AnnAssign):
+            if stmt.value is not None:
+                self._scan_expr(stmt.value, taint, env)
+                self._assign_target(stmt.target, taint,
+                                    self.tainted(stmt.value, taint))
+            return
+        if isinstance(stmt, ast.AugAssign):
+            self._scan_expr(stmt.value, taint, env)
+            if self.tainted(stmt.value, taint):
+                self._assign_target(stmt.target, taint, True)
+            return
+        if isinstance(stmt, (ast.If, ast.While)):
+            self._scan_expr(stmt.test, taint, env)
+            if self.tainted(stmt.test, taint):
+                kind = "if" if isinstance(stmt, ast.If) else "while"
+                self._flag(
+                    "GA-A003", stmt,
+                    f"Python `{kind}` on a traced value — use lax.cond/"
+                    "jnp.where (a vmapped branch executes both sides)")
+            self._lint_body(stmt.body, taint, static, env)
+            self._lint_body(stmt.orelse, taint, static, env)
+            return
+        if isinstance(stmt, ast.For):
+            self._scan_expr(stmt.iter, taint, env)
+            self._assign_target(stmt.target, taint,
+                                self.tainted(stmt.iter, taint))
+            self._lint_body(stmt.body, taint, static, env)
+            self._lint_body(stmt.orelse, taint, static, env)
+            return
+        if isinstance(stmt, ast.Return):
+            if stmt.value is not None:
+                self._scan_expr(stmt.value, taint, env)
+            return
+        if isinstance(stmt, ast.Expr):
+            self._scan_expr(stmt.value, taint, env)
+            return
+        if isinstance(stmt, (ast.With, ast.Try)):
+            for child in ast.iter_child_nodes(stmt):
+                if isinstance(child, ast.stmt):
+                    self._lint_stmt(child, taint, static, env)
+                elif isinstance(child, ast.expr):
+                    self._scan_expr(child, taint, env)
+            return
+        # default: scan embedded expressions for call-site rules
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.expr):
+                self._scan_expr(child, taint, env)
+
+    # -------------------------------------------------------- expressions
+
+    def _scan_expr(self, expr: ast.expr, taint: set[str], env: dict) -> None:
+        if isinstance(expr, ast.Call):
+            self._check_call(expr, taint, env)
+            for part in list(expr.args) + [kw.value for kw in expr.keywords]:
+                self._scan_expr(part, taint, env)
+            if not isinstance(expr.func, ast.Name):
+                self._scan_expr(expr.func, taint, env)
+            return
+        if isinstance(expr, ast.IfExp):
+            if self.tainted(expr.test, taint):
+                self._flag(
+                    "GA-A003", expr,
+                    "ternary on a traced value — use jnp.where/lax.cond")
+            for part in (expr.test, expr.body, expr.orelse):
+                self._scan_expr(part, taint, env)
+            return
+        if isinstance(expr, ast.Lambda):
+            # lambdas ARE the scan/cond bodies: their params are tracers
+            inner = (set(taint) - set(_param_names(expr))) \
+                | set(_param_names(expr))
+            self._scan_expr(expr.body, inner, env)
+            return
+        for child in ast.iter_child_nodes(expr):
+            if isinstance(child, ast.expr):
+                self._scan_expr(child, taint, env)
+
+    def _resolve(self, node: ast.expr, env: dict):
+        if isinstance(node, ast.Name):
+            target = env.get(node.id)
+            if isinstance(target, ast.FunctionDef):
+                return target
+        return None
+
+    def _check_call(self, call: ast.Call, taint: set[str], env: dict) -> None:
+        f = call.func
+        argish = list(call.args) + [kw.value for kw in call.keywords]
+        # inner functions passed as VALUES (scan/while/cond bodies, vmap
+        # operands, cond branches): all their params receive tracers.
+        # Names in callee position of a nested call are direct calls, not
+        # value references — those are handled by per-param recording.
+        for a in argish:
+            callee_ids = {id(c.func) for c in ast.walk(a)
+                          if isinstance(c, ast.Call)
+                          and isinstance(c.func, ast.Name)}
+            for n in ast.walk(a):
+                if id(n) in callee_ids:
+                    continue
+                target = self._resolve(n, env)
+                if target is not None:
+                    self._force(target)
+        # direct calls to inner functions: record per-parameter taint
+        target = self._resolve(f, env)
+        if target is not None:
+            names = _param_names(target)
+            for i, a in enumerate(call.args):
+                if isinstance(a, ast.Starred):
+                    self._force(target)
+                    break
+                if i < len(names):
+                    self._record_param(target, names[i],
+                                      self.tainted(a, taint))
+            for kw in call.keywords:
+                if kw.arg is None:
+                    self._force(target)
+                elif kw.arg in names:
+                    self._record_param(target, kw.arg,
+                                       self.tainted(kw.value, taint))
+        any_tainted_arg = any(self.tainted(a, taint) for a in argish)
+        if isinstance(f, ast.Name) and f.id in _COERCIONS and any_tainted_arg:
+            self._flag(
+                "GA-A002", call,
+                f"{f.id}() on a traced value forces a host round-trip "
+                "(TracerBoolConversionError under jit)")
+        if isinstance(f, ast.Attribute):
+            base = f.value
+            if (isinstance(base, ast.Name)
+                    and base.id in _HOST_MATH_MODULES and any_tainted_arg):
+                self._flag(
+                    "GA-A001", call,
+                    f"{base.id}.{f.attr}() on a traced value — use the "
+                    "jnp./lax. equivalent (host math breaks the trace)")
+            if f.attr in _HOST_SYNC_ATTRS and self.tainted(base, taint):
+                self._flag(
+                    "GA-A004", call,
+                    f".{f.attr}() inside a traced scope synchronizes with "
+                    "the host")
+            if (f.attr == "device_get" and isinstance(base, ast.Name)
+                    and base.id == "jax" and any_tainted_arg):
+                self._flag(
+                    "GA-A004", call,
+                    "jax.device_get() inside a traced scope synchronizes "
+                    "with the host")
+
+
+def _check_json_calls(tree: ast.Module, path: str, suppressed: set[int],
+                      violations: list[Violation]) -> None:
+    """GA-A005 over the whole file (artifact writers live outside jit)."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if not (isinstance(f, ast.Attribute)
+                and f.attr in ("dump", "dumps")
+                and isinstance(f.value, ast.Name) and f.value.id == "json"):
+            continue
+        ok = any(
+            kw.arg == "allow_nan"
+            and isinstance(kw.value, ast.Constant) and kw.value.value is False
+            for kw in node.keywords)
+        if not ok and node.args:
+            first = node.args[0]
+            if (isinstance(first, ast.Call)
+                    and ((isinstance(first.func, ast.Name)
+                          and first.func.id == "sanitize_nonfinite")
+                         or (isinstance(first.func, ast.Attribute)
+                             and first.func.attr == "sanitize_nonfinite"))):
+                ok = True
+        if not ok and node.lineno not in suppressed:
+            violations.append(Violation(
+                rule="GA-A005", file=path, line=node.lineno,
+                message=f"json.{f.attr}() without allow_nan=False — wrap the "
+                        "payload in runtime.summarize.sanitize_nonfinite() "
+                        "or pass allow_nan=False (strict-JSON artifacts)"))
+
+
+def lint_source(source: str, path: str) -> list[Violation]:
+    """Lint one file's source text; `path` is used only for reporting."""
+    violations: list[Violation] = []
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        return [Violation(rule="GA-A001", file=path, line=e.lineno or 0,
+                          message=f"syntax error: {e.msg}")]
+    suppressed = suppressed_lines(source)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef):
+            static = _traced_decoration(node)
+            if static is not None:
+                linter = _ScopeLinter(path, suppressed, violations)
+                linter.lint_scope(node, static)
+    _check_json_calls(tree, path, suppressed, violations)
+    return violations
+
+
+def lint_paths(paths: list[str], repo_root: str) -> tuple[list[Violation], int]:
+    """Lint every .py file under `paths`; returns (violations, file_count)."""
+    files: list[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = [d for d in dirnames
+                               if d not in ("__pycache__", ".git")]
+                files.extend(os.path.join(dirpath, fn)
+                             for fn in filenames if fn.endswith(".py"))
+        elif p.endswith(".py"):
+            files.append(p)
+    violations: list[Violation] = []
+    for fp in sorted(set(files)):
+        with open(fp, encoding="utf-8") as f:
+            source = f.read()
+        rel = os.path.relpath(fp, repo_root)
+        violations.extend(lint_source(source, rel))
+    return violations, len(set(files))

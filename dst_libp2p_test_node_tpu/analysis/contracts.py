@@ -1,0 +1,76 @@
+"""Contract model for graft-audit's jaxpr engine.
+
+An EntrypointContract declares, once per hot entrypoint, everything the
+static auditor needs to certify it without running it:
+
+  - ``build``: a zero-arg thunk returning a TraceSpec (fn + concrete small
+    args). The auditor traces ``lambda: fn(*args, **kwargs)`` abstractly —
+    closure capture sidesteps all static-argument plumbing, and nothing
+    executes on a device.
+  - ``expected_conds``: the number of scalar-predicate ``lax.cond`` branches
+    that must SURVIVE in the traced jaxpr. The simulator's perf story leans
+    on real XLA branches (steady-state heartbeat skips, the serialized-answer
+    repair, the warm-start cold rerun); a refactor that lets vmap batch one
+    of those predicates silently lowers it to ``select_n`` and executes both
+    sides every call. A surviving-cond count below the declared number is
+    exactly that regression (rule GA-J003).
+  - ``donate``: positional arg indices whose buffers the caller may donate.
+    The auditor lowers ``jax.jit(fn, donate_argnums=donate)`` and requires
+    the ``tf.aliasing_output`` annotations to actually appear — donation
+    that silently fails to alias is a 2x memory bill at the 1M-peer ladder
+    rung (rule GA-J004).
+  - ``ladder``: named aval families (miniatures of the bench ladder rungs).
+    Distinct compile keys — (static args, leaf avals incl. weak_type) —
+    must number exactly ``expected_compile_keys`` (rule GA-J005).
+  - ``feedback``: (out_get, arg_get) pairs for carried outputs (e.g. the
+    new SimState fed back into the next publish). Output avals must equal
+    the argument avals leaf-for-leaf, or every iteration recompiles
+    (rule GA-J005).
+  - ``runtime_check``: opt-in checkify half — a thunk that runs the
+    entrypoint CONCRETELY on the canonical config under
+    ``jax.experimental.checkify`` and asserts value-level invariants the
+    static engine cannot see (mesh-degree bounds, non-negative delays).
+
+Registering a new entrypoint = adding one EntrypointContract to
+``registry.default_contracts()``; the audit CLI and the tier-1 gate pick it
+up automatically.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+
+@dataclasses.dataclass
+class TraceSpec:
+    fn: Callable
+    args: tuple = ()
+    kwargs: dict = dataclasses.field(default_factory=dict)
+
+    def thunk(self) -> Callable[[], Any]:
+        return lambda: self.fn(*self.args, **self.kwargs)
+
+
+@dataclasses.dataclass
+class LadderRung:
+    """One aval family for compile-key counting: a name, the hashable
+    static-argument fingerprint, and the dynamic arg pytree."""
+    name: str
+    statics: Any              # hashable fingerprint (e.g. the SimParams)
+    dynamic: Any              # pytree of arrays / scalars
+
+
+@dataclasses.dataclass
+class EntrypointContract:
+    name: str
+    build: Callable[[], TraceSpec]
+    expected_conds: int | None = None
+    donate: tuple[int, ...] | None = None
+    ladder: Callable[[], list[LadderRung]] | None = None
+    expected_compile_keys: int | None = None
+    # each pair: (output_getter(outputs) -> pytree, arg_getter(spec) -> pytree)
+    feedback: list[tuple[Callable, Callable]] = dataclasses.field(
+        default_factory=list)
+    runtime_check: Callable[[], None] | None = None
+    notes: str = ""

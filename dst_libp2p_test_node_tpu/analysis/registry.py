@@ -1,0 +1,274 @@
+"""The hot-entrypoint contract registry.
+
+Canonical small configs (N=32 single topic, T=2 x N=16 multitopic, 3-rung
+aval-family miniature of the bench ladder) are built once per process and
+shared across contracts — building them is pure numpy/host work plus a few
+tiny device constants; the audit itself never executes a registered
+entrypoint concretely (checkify mode excepted).
+
+The registered surface mirrors the BENCH hot paths exactly:
+
+  disseminate/cold        serialized-answer publish (1 surviving cond: the
+                          exact-mode repair branch)
+  disseminate/warm        warm-started publish (2 surviving conds: repair +
+                          the cold-rerun guard)
+  disseminate/bounded     bounded-accounting publish (cond-free by design)
+  heartbeat_step          one mesh-maintenance round (4 steady-state skips)
+  run_heartbeats          the simulator scan step (conds must survive the
+                          scan body)
+  run_attacked_heartbeats the campaign attack window, UNBATCHED trial form
+                          (the vmapped multi-seed form in runtime/campaign.py
+                          intentionally trades these conds for select_n —
+                          that form is deliberately NOT registered with a
+                          cond contract; see docs/ARCHITECTURE.md §9)
+  kad/find_node           the DHT lookup scan
+  multitopic/disseminate  the T*N block-diagonal publish
+"""
+
+from __future__ import annotations
+
+import functools
+
+from .contracts import EntrypointContract, LadderRung, TraceSpec
+
+
+@functools.lru_cache(maxsize=None)
+def _single_topic(n: int = 32, connect_to: int = 4, **over):
+    import jax.numpy as jnp
+
+    from ..config.topology import Topology, TopoParams
+    from ..ops.graph import build_connection_graph
+    from ..ops.state import SimParams, graph_arrays, init_state
+
+    g = build_connection_graph(n, connect_to, seed=0)
+    params = SimParams(n=n, capacity=g.capacity, **dict(over))
+    state = init_state(params, seed=0)
+    a = graph_arrays(g)
+    t = Topology.build(TopoParams(
+        network_size=n, anchor_stages=5, min_bandwidth=50, max_bandwidth=150,
+        min_latency=40, max_latency=130))
+    topo = (jnp.asarray(t.stage_of_peer), jnp.asarray(t.latency_ms),
+            jnp.asarray(t.bw_up_mbit))
+    return g, params, state, a, topo
+
+
+def _disseminate_spec(**params_over) -> TraceSpec:
+    from ..ops.disseminate import disseminate
+
+    g, params, state, a, (stage, lat, bw) = _single_topic(
+        **{k: v for k, v in params_over.items()})
+    return TraceSpec(
+        fn=disseminate,
+        args=(state, a["conns"], a["rev"], stage, lat, bw),
+        kwargs=dict(publisher=3, t0_ms=0.0, params=params,
+                    payload_bytes=15000))
+
+
+def _heartbeat_spec(fn_name: str) -> TraceSpec:
+    from ..ops import heartbeat
+
+    g, params, state, a, _ = _single_topic()
+    fn = getattr(heartbeat, fn_name)
+    kwargs = {"params": params}
+    if fn_name == "run_heartbeats":
+        kwargs["steps"] = 4
+    return TraceSpec(
+        fn=fn, args=(state, a["conns"], a["rev"], a["out_mask"]),
+        kwargs=kwargs)
+
+
+def _attack_spec() -> TraceSpec:
+    import jax.numpy as jnp
+
+    from ..ops.adversary import (AdversaryParams, attacker_cohort,
+                                 run_attacked_heartbeats)
+
+    g, params, state, a, _ = _single_topic()
+    att = jnp.asarray(attacker_cohort(params.n, 0.25, seed=1))
+    return TraceSpec(
+        fn=run_attacked_heartbeats,
+        args=(state, a["conns"], a["rev"], a["out_mask"], att),
+        kwargs=dict(params=params, adv=AdversaryParams(), steps=4))
+
+
+def _kad_spec() -> TraceSpec:
+    import jax.numpy as jnp
+
+    from ..ops import kad
+
+    g, params, state, a, (stage, lat, bw) = _single_topic()
+    st = kad.init_kad_state(params.n, seed=0)
+    origins = jnp.arange(4, dtype=jnp.int32)
+    return TraceSpec(
+        fn=kad.find_node,
+        args=(st, origins, st.keys[origins], stage, lat),
+        kwargs=dict(rounds=3))
+
+
+@functools.lru_cache(maxsize=None)
+def _multitopic_sim():
+    from ..config.topology import TopoParams
+    from ..runtime.multitopic import MultiTopicConfig, MultiTopicSimulator
+
+    cfg = MultiTopicConfig(
+        topo=TopoParams(network_size=16, anchor_stages=1),
+        topics=("a", "b"), connect_to=3)
+    return MultiTopicSimulator(cfg)
+
+
+def _multitopic_spec() -> TraceSpec:
+    from ..ops.disseminate import disseminate
+
+    sim = _multitopic_sim()
+    return TraceSpec(
+        fn=disseminate,
+        args=(sim.state, sim.arrays["conns"], sim.arrays["rev"], sim._stage,
+              sim._lat, sim._bw),
+        kwargs=dict(publisher=16 + 3, t0_ms=0.0, params=sim.params,
+                    payload_bytes=500, lat_edge=sim._lat_edge,
+                    ans_tables=sim._ans_tables))
+
+
+def _disseminate_ladder() -> list[LadderRung]:
+    """Miniature of the bench ladder's aval families: three network sizes
+    plus a REPEAT of the first — 4 rungs must produce exactly 3 compile
+    keys (distinct sizes split, identical configs collapse)."""
+    rungs = []
+    for name, n, ct in (("rung-16", 16, 3), ("rung-32", 32, 4),
+                        ("rung-64", 64, 5), ("rung-16-again", 16, 3)):
+        g, params, state, a, (stage, lat, bw) = _single_topic(
+            n=n, connect_to=ct)
+        rungs.append(LadderRung(
+            name=name, statics=(params, 15000),
+            dynamic=(state, a["conns"], a["rev"], stage, lat, bw, 3, 0.0)))
+    return rungs
+
+
+def _new_state_of(out):
+    return out[1]
+
+
+def _state_arg_of(spec):
+    return spec.args[0]
+
+
+def _first_out(out):
+    return out[0]
+
+
+def _checkify_heartbeat() -> None:
+    """Runtime half of the heartbeat contract: from the canonical warm mesh,
+    one scan keeps D_lo <= |mesh| <= D_hi for every live peer."""
+    import jax.numpy as jnp
+    from jax.experimental import checkify
+
+    from ..ops.heartbeat import run_heartbeats
+
+    g, params, state, a, _ = _single_topic()
+
+    def prog(state):
+        s = run_heartbeats(state, a["conns"], a["rev"], a["out_mask"],
+                           params, 8)
+        deg = s.mesh_mask.sum(axis=-1)
+        checkify.check(
+            jnp.all((deg >= params.d_low) & (deg <= params.d_high)),
+            "mesh degree left [D_lo, D_hi]")
+        checkify.check(
+            jnp.all(s.fmd >= 0.0), "score decay went negative")
+        return s
+
+    err, _ = checkify.checkify(prog)(state)
+    err.throw()
+
+
+def _checkify_disseminate() -> None:
+    """Runtime half of the publish contract: delays are non-negative where
+    received, and the bounded-mode wait bar is finite (json-safe)."""
+    import jax.numpy as jnp
+    from jax.experimental import checkify
+
+    from ..ops.disseminate import disseminate
+    from ..ops.heartbeat import run_heartbeats
+
+    g, params, state, a, (stage, lat, bw) = _single_topic()
+    state = run_heartbeats(state, a["conns"], a["rev"], a["out_mask"],
+                           params, 8)
+
+    # checkify cannot trace the fixpoint's batched while-loop
+    # (checkify-of-vmap-of-while is unsupported), so run the publish
+    # concretely and checkify only the assertions over its outputs.
+    res, _s2 = disseminate(
+        state, a["conns"], a["rev"], stage, lat, bw, publisher=3,
+        t0_ms=0.0, params=params, payload_bytes=15000)
+
+    def prog(received, delay_ms, answer_wait_max_ms):
+        checkify.check(
+            jnp.all(jnp.where(received, delay_ms, 0.0) >= 0.0),
+            "negative dissemination delay")
+        checkify.check(
+            jnp.isfinite(answer_wait_max_ms),
+            "non-finite answer wait bar (would poison strict JSON)")
+        return received
+
+    err, _ = checkify.checkify(prog)(
+        res.received, res.delay_ms, res.answer_wait_max_ms)
+    err.throw()
+
+
+def default_contracts() -> list[EntrypointContract]:
+    return [
+        EntrypointContract(
+            name="disseminate/cold",
+            build=lambda: _disseminate_spec(),
+            expected_conds=1,
+            donate=(0,),
+            ladder=_disseminate_ladder,
+            expected_compile_keys=3,
+            feedback=[(_new_state_of, _state_arg_of)],
+            runtime_check=_checkify_disseminate,
+            notes="serialized-answer repair branch must stay a real cond"),
+        EntrypointContract(
+            name="disseminate/warm",
+            build=lambda: _disseminate_spec(warm_start=True),
+            expected_conds=2,
+            feedback=[(_new_state_of, _state_arg_of)],
+            notes="repair + cold-rerun guard both survive"),
+        EntrypointContract(
+            name="disseminate/bounded",
+            build=lambda: _disseminate_spec(serialize_answers=False),
+            expected_conds=None,
+            feedback=[(_new_state_of, _state_arg_of)],
+            notes="cond-free by design; loop/carry rules still apply"),
+        EntrypointContract(
+            name="heartbeat_step",
+            build=lambda: _heartbeat_spec("heartbeat_step"),
+            expected_conds=4,
+            donate=(0,),
+            notes="graft/prune/fanout/deg skips are the steady-state perf"),
+        EntrypointContract(
+            name="run_heartbeats",
+            build=lambda: _heartbeat_spec("run_heartbeats"),
+            expected_conds=4,
+            donate=(0,),
+            feedback=[(lambda out: out, _state_arg_of)],
+            runtime_check=_checkify_heartbeat,
+            notes="the simulator scan step; conds live inside the scan body"),
+        EntrypointContract(
+            name="run_attacked_heartbeats",
+            build=_attack_spec,
+            expected_conds=4,
+            feedback=[(_first_out, _state_arg_of)],
+            notes="UNBATCHED campaign window; the vmapped trial batch "
+                  "intentionally elides these conds and is not registered"),
+        EntrypointContract(
+            name="kad/find_node",
+            build=_kad_spec,
+            feedback=[(lambda out: out[1], _state_arg_of)],
+            notes="lookup scan: loop/carry rules only"),
+        EntrypointContract(
+            name="multitopic/disseminate",
+            build=_multitopic_spec,
+            expected_conds=1,
+            feedback=[(_new_state_of, _state_arg_of)],
+            notes="T*N block-diagonal stack keeps the single-topic conds"),
+    ]

@@ -1,0 +1,83 @@
+"""Violation model + strict-JSON report for graft-audit.
+
+Every finding — from the AST linter or the jaxpr auditor — is a Violation
+with a stable rule id, a repo-relative file:line anchor, and (for jaxpr
+rules) the registered entrypoint it was traced under. The report is strict
+JSON (`allow_nan=False`, sorted keys, deterministic violation order) so CI
+and the bench artifact pipeline can diff it byte-for-byte.
+
+Rule catalog (see docs/ARCHITECTURE.md §10 for the long-form version):
+
+  GA-J001  host/io/debug callback inside a scan/while_loop body
+  GA-J002  x64 dtype or weak-type promotion drift in a loop carry
+  GA-J003  declared lax.cond elided (vmapped cond lowered to select_n)
+  GA-J004  declared buffer donation does not hold in the lowering
+  GA-J005  compile-key count / feedback aval drift across the bench ladder
+  GA-A001  np./math. call on a traced value inside a jitted scope
+  GA-A002  float()/int()/bool() host coercion of a traced value
+  GA-A003  Python `if`/`while`/ternary branching on a traced value
+  GA-A004  device_get/block_until_ready/.item() host sync in a jitted scope
+  GA-A005  json.dump without allow_nan=False or sanitize_nonfinite()
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+
+SUPPRESS_COMMENT = "# graft-audit: ok"
+
+RULES = {
+    "GA-J001": "callback-in-loop",
+    "GA-J002": "x64-or-weak-carry",
+    "GA-J003": "cond-elided",
+    "GA-J004": "donation-not-honored",
+    "GA-J005": "compile-key-drift",
+    "GA-A001": "np-math-on-tracer",
+    "GA-A002": "host-coercion-of-tracer",
+    "GA-A003": "python-branch-on-tracer",
+    "GA-A004": "host-sync-in-traced-scope",
+    "GA-A005": "nonfinite-reachable-json",
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    rule: str              # GA-Jxxx / GA-Axxx id from RULES
+    file: str              # repo-relative path (or module path for traces)
+    line: int              # 1-based; 0 when no source anchor exists
+    message: str
+    entrypoint: str | None = None  # registry name for jaxpr-engine findings
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["slug"] = RULES.get(self.rule, "unknown")
+        return d
+
+
+def render_report(violations: list[Violation], *, checked_files: int = 0,
+                  checked_entrypoints: int = 0) -> str:
+    """Strict-JSON audit report; deterministic ordering, refuses NaN/Inf."""
+    vs = sorted(violations, key=lambda v: (v.file, v.line, v.rule, v.message))
+    counts: dict[str, int] = {}
+    for v in vs:
+        counts[v.rule] = counts.get(v.rule, 0) + 1
+    out = {
+        "tool": "graft-audit",
+        "version": 1,
+        "clean": not vs,
+        "checked_files": checked_files,
+        "checked_entrypoints": checked_entrypoints,
+        "counts": counts,
+        "violations": [v.to_dict() for v in vs],
+    }
+    return json.dumps(out, indent=2, sort_keys=True, allow_nan=False)
+
+
+def suppressed_lines(source: str) -> set[int]:
+    """1-based line numbers carrying the in-line waiver comment."""
+    return {
+        i
+        for i, text in enumerate(source.splitlines(), start=1)
+        if SUPPRESS_COMMENT in text
+    }

@@ -25,6 +25,9 @@ Subcommands:
   connmanager — hub-and-spoke watermark/reconnect stress workload.
   servicedisco — advertise/lookup service discovery over the DHT.
   regression — GossipSub-over-kad-dht discovery workload with mesh pings.
+  lint       — graft-audit static certification: AST lint over the python
+               surface + jaxpr audit of every registered hot entrypoint
+               (analysis/). Strict-JSON report on stdout, exit 0 iff clean.
 
 Usage:
   python -m dst_libp2p_test_node_tpu run 1 1000 15000 1 10 50 150 40 130 5 0.0 4 0 4000
@@ -35,6 +38,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -289,9 +293,11 @@ def cmd_run(argv: list[str]) -> int:
             f"lines={n_lines}"
         )
         if a.stats_json:
+            from .runtime.summarize import sanitize_nonfinite
+
             with open(f"{a.out_prefix}stats{i}.json", "w") as f:
                 json.dump(
-                    {
+                    sanitize_nonfinite({
                         "network_size": s.network_size,
                         "coverage": s.coverage(),
                         "max_latency_ms": s.max_latency_ms,
@@ -299,9 +305,10 @@ def cmd_run(argv: list[str]) -> int:
                         "avg_max_latency_ms": s.avg_max_latency_ms,
                         "wall_s": wall,
                         "peer_rounds_per_sec": sim.peer_rounds_per_sec(wall),
-                    },
+                    }),
                     f,
                     indent=2,
+                    allow_nan=False,
                 )
     return 0
 
@@ -665,9 +672,63 @@ def cmd_inject(argv: list[str]) -> int:
         peer_selection=a.peer_selection, publisher_id=a.publisher_id,
     )
     for r in res.replies:
-        print(json.dumps(r))
+        print(json.dumps(r, allow_nan=False))
     print(f"published ok={res.ok} failed={res.failed}")
     return 0 if res.failed == 0 else 1
+
+
+def cmd_lint(argv: list[str]) -> int:
+    """graft-audit: static certification of the hot paths (analysis/).
+
+    Runs the AST lint over the package + bench/scripts sources and the
+    jaxpr auditor over every registered entrypoint contract, then emits a
+    strict-JSON violation report on stdout. Exit 0 iff clean.
+    """
+    p = argparse.ArgumentParser(prog="lint")
+    p.add_argument("paths", nargs="*",
+                   help="files/dirs for the AST engine (default: the repo's "
+                        "python surface: package, bench*.py, scripts/)")
+    p.add_argument("--no-ast", action="store_true",
+                   help="skip the AST lint engine")
+    p.add_argument("--no-jaxpr", action="store_true",
+                   help="skip the jaxpr auditor (fast, no jax tracing)")
+    p.add_argument("--checkify", action="store_true",
+                   help="also run the opt-in runtime half of the contracts "
+                        "(executes small configs under jax.experimental."
+                        "checkify; slower)")
+    a = p.parse_args(argv)
+
+    from .analysis import audit_contracts, lint_paths, render_report, run_checkify
+    from .analysis.registry import default_contracts
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    violations = []
+    checked_files = 0
+    checked_entrypoints = 0
+
+    if not a.no_ast:
+        if a.paths:
+            targets = a.paths
+        else:
+            pkg = os.path.dirname(os.path.abspath(__file__))
+            targets = [pkg]
+            for extra in ("bench.py", "bench_configs.py", "scripts"):
+                cand = os.path.join(repo_root, extra)
+                if os.path.exists(cand):
+                    targets.append(cand)
+        ast_violations, checked_files = lint_paths(targets, repo_root)
+        violations.extend(ast_violations)
+
+    if not a.no_jaxpr:
+        contracts = default_contracts()
+        checked_entrypoints = len(contracts)
+        violations.extend(audit_contracts(contracts))
+        if a.checkify:
+            violations.extend(run_checkify(contracts))
+
+    print(render_report(violations, checked_files=checked_files,
+                        checked_entrypoints=checked_entrypoints))
+    return 1 if violations else 0
 
 
 def cmd_summarize(argv: list[str]) -> int:
@@ -725,6 +786,8 @@ def main(argv: list[str] | None = None) -> int:
         return cmd_servicedisco(rest)
     if cmd == "regression":
         return cmd_regression(rest)
+    if cmd == "lint":
+        return cmd_lint(rest)
     print(f"unknown command: {cmd}\n{__doc__}", file=sys.stderr)
     return 2
 

@@ -970,8 +970,11 @@ def disseminate(
         # NET-WORSE here r4: the per-iteration cost is pull-dominated, so
         # skipping the gossip candidate arithmetic saves little while the
         # extra warm-up iterations add whole pulls)
+        # iteration counter carries a STRONG int32: a Python-int carry is
+        # weak-typed and re-promotes on feed-back (graft-audit GA-J002)
         t_rx, inc, changed, _ = jax.lax.while_loop(
-            cond, body, (t0, jnp.full(conns.shape, INF), jnp.bool_(True), 0))
+            cond, body,
+            (t0, jnp.full(conns.shape, INF), jnp.bool_(True), jnp.int32(0)))
         return t_rx, inc, ~changed
 
     def _converge_floor(rank, k_p, frag_idx, t_pub, send_mask, g_floor,
@@ -1018,7 +1021,8 @@ def disseminate(
                     jnp.minimum(pull(cand).min(axis=-1), g_floor), rx_const))
             return t_new, jnp.any(t_new < t_rx), it + 1
 
-        t_rx, _, _ = jax.lax.while_loop(cond, body, (t0, jnp.bool_(True), 0))
+        t_rx, _, _ = jax.lax.while_loop(
+            cond, body, (t0, jnp.bool_(True), jnp.int32(0)))
         return t_rx
 
     def _converge_serialized(rank, k_p, frag_idx, t_pub, send_mask,
@@ -1068,7 +1072,7 @@ def disseminate(
         t0 = (jnp.full((n,), INF) if t_seed is None else t_seed
               ).at[publisher].set(t_pub)
         _, t, changed, _ = jax.lax.while_loop(
-            cond, body, (t0, t0, jnp.bool_(True), 0))
+            cond, body, (t0, t0, jnp.bool_(True), jnp.int32(0)))
         return t, ~changed
 
     def queue_drop(tgt_mask, frag_idx):

@@ -147,7 +147,6 @@ def _insert_one(table: jnp.ndarray, keys: jnp.ndarray, owner: jnp.ndarray,
     into free slots, drop the rest. Pure fixed-shape: compute each candidate's
     target (bucket, position) and scatter with out-of-bounds drop."""
     b, k = table.shape
-    e = cands.shape[0]
     valid = (cands >= 0) & (cands != owner)
     d = _dist(keys, cands, keys[owner])
     slot = bucket_slot(d, b)
@@ -286,7 +285,6 @@ def find_node(
     q = origins.shape[0]
     s = shortlist
 
-    o_key = state.keys[origins]                           # (Q, W)
     o_stage = stage[origins]
 
     def response(peer, target_key):

@@ -29,7 +29,6 @@ t_rx[p] and the sender's heartbeat phase (see test_exchange.py).
 
 from __future__ import annotations
 
-from functools import partial
 
 import jax
 import jax.numpy as jnp
@@ -192,8 +191,9 @@ def converge_recv(
         return t_new, inc, jnp.any(t_new < t_rx), it + 1
 
     inc0 = jnp.full(c.src.shape, INF)
+    # strong int32 counter: a Python-int carry is weak-typed (GA-J002)
     t_rx, inc, changed, _ = jax.lax.while_loop(
-        cond, body, (t0, inc0, jnp.bool_(True), 0))
+        cond, body, (t0, inc0, jnp.bool_(True), jnp.int32(0)))
     return t_rx, inc, ~changed
 
 
@@ -237,7 +237,8 @@ def converge_sharded(
             return t_new, inc, changed, it + 1
 
         t_l, inc_l, changed, _ = jax.lax.while_loop(
-            cond, body, (t0_l, jnp.full(src.shape, INF), jnp.bool_(True), 0))
+            cond, body,
+            (t0_l, jnp.full(src.shape, INF), jnp.bool_(True), jnp.int32(0)))
         return t_l, inc_l, ~changed
 
     fn = _shard_map(

@@ -26,7 +26,6 @@ summary_shadowlog.awk:133-140).
 from __future__ import annotations
 
 import io
-import math
 from dataclasses import dataclass
 
 import numpy as np

@@ -70,6 +70,7 @@ from ..ops.adversary import (
     run_attacked_heartbeats,
 )
 from .simulator import ExperimentConfig, MessageRecord, Simulator
+from .summarize import sanitize_nonfinite
 
 # an attack "engaged" when this fraction of honest->attacker edges is
 # graylisted (1.0 is the steady state; <1.0 tolerates stragglers whose
@@ -165,12 +166,9 @@ class TrialResult:
     wall_s: float
 
     def to_dict(self) -> dict:
-        d = {}
-        for k, v in self.__dict__.items():
-            if isinstance(v, float) and not math.isfinite(v):
-                v = None  # strict-JSON consumers run allow_nan=False
-            d[k] = v
-        return d
+        # strict-JSON consumers run allow_nan=False; the shared sanitizer
+        # nulls the legitimately-infinite fields (e.g. hb_budget)
+        return sanitize_nonfinite(dict(self.__dict__))
 
 
 @dataclass
@@ -186,14 +184,14 @@ class CampaignResult:
         return len(self.trials) / max(self.wall_s, 1e-9)
 
     def to_dict(self) -> dict:
-        return {
+        return sanitize_nonfinite({
             "scenario": self.scenario,
             "network_size": self.network_size,
-            "hb_budget": self.hb_budget if math.isfinite(self.hb_budget) else None,
+            "hb_budget": self.hb_budget,
             "wall_s": self.wall_s,
             "trials_per_s": self.trials_per_s,
             "trials": [t.to_dict() for t in self.trials],
-        }
+        })
 
 
 # --------------------------------------------------------------------- trials

@@ -115,7 +115,7 @@ def save_checkpoint(sim: Simulator, path: str) -> None:
         "t_ms": float(sim.state.t_ms),
     }
     arrays: dict = {"meta_json": np.frombuffer(
-        json.dumps(meta).encode(), dtype=np.uint8)}
+        json.dumps(meta, allow_nan=False).encode(), dtype=np.uint8)}
     for k, v in serialization.to_state_dict(sim.state).items():
         arrays[f"state/{k}"] = np.asarray(v)
     # host-side counters that are NOT SimState leaves: cumulative

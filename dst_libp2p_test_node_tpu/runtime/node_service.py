@@ -63,7 +63,7 @@ class PublishQueue:
 
 
 def _json_response(handler, code: int, payload: dict) -> None:
-    body = json.dumps(payload).encode()
+    body = json.dumps(payload, allow_nan=False).encode()
     handler.send_response(code)
     handler.send_header("Content-Type", "application/json")
     handler.send_header("Content-Length", str(len(body)))

@@ -49,7 +49,8 @@ def publish_once(
     req = urllib.request.Request(
         f"{target}/publish",
         data=json.dumps(
-            {"topic": topic, "msgSize": msg_size, "version": version}
+            {"topic": topic, "msgSize": msg_size, "version": version},
+            allow_nan=False,
         ).encode(),
         headers={"Content-Type": "application/json"},
         method="POST",

@@ -27,7 +27,7 @@ from the stage latency matrix + muxer processing, logged in the reference's
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 import numpy as np
 

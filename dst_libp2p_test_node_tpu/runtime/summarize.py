@@ -20,10 +20,38 @@ unchanged on our latencies files — that is covered by tests running real awk.
 
 from __future__ import annotations
 
+import math
 import re
 from dataclasses import dataclass, field
 
 HOP_LAT_MS = 100  # "should be consistent with shadow.yaml" (summary_latency.awk:8)
+
+
+def sanitize_nonfinite(obj):
+    """Recursively replace non-finite floats with None for strict-JSON
+    artifact writers (json.dump refuses NaN/Inf only with allow_nan=False;
+    without it they silently become invalid JSON literals).
+
+    The canonical fix for graft-audit rule GA-A005: every artifact writer
+    routes its payload through this helper (and keeps allow_nan=False as a
+    backstop). Finite values pass through untouched, so the transform is
+    the identity on healthy artifacts; numpy scalars are coerced to native
+    Python so the sanitized payload is always json-serializable."""
+    if isinstance(obj, dict):
+        return {k: sanitize_nonfinite(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [sanitize_nonfinite(v) for v in obj]
+    if isinstance(obj, bool):
+        return obj
+    if isinstance(obj, float):
+        return obj if math.isfinite(obj) else None
+    if hasattr(obj, "item") and not isinstance(obj, (str, bytes, int)):
+        # numpy / jax scalar: unwrap, then re-check finiteness
+        try:
+            return sanitize_nonfinite(obj.item())
+        except (AttributeError, TypeError, ValueError):
+            return obj
+    return obj
 
 # grep-style line: <path>:<lineno>:<msgId> milliseconds: <ms>
 # accept both peer<i> (awk-compatible) and pod-<i> (reference topogen) naming

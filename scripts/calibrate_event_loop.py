@@ -117,10 +117,10 @@ def main() -> None:
                    help="write the measurement artifact (JSON)")
     a = p.parse_args()
     result = asyncio.run(_run())
-    print(json.dumps(result, indent=2))
+    print(json.dumps(result, indent=2, allow_nan=False))
     if a.write:
         with open(a.write, "w") as f:
-            json.dump(result, f, indent=2)
+            json.dump(result, f, indent=2, allow_nan=False)
             f.write("\n")
 
 

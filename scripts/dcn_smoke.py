@@ -59,7 +59,7 @@ def worker(process_id: int) -> None:
 
     import jax.numpy as jnp
     import numpy as np
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.sharding import PartitionSpec as P
 
     from dst_libp2p_test_node_tpu.parallel.sharding import (
         initialize_multihost, make_peer_mesh, peer_sharding,

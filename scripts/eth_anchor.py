@@ -58,6 +58,7 @@ from dst_libp2p_test_node_tpu.config.env import GossipSubParams  # noqa: E402
 from dst_libp2p_test_node_tpu.config.topology import TopoParams  # noqa: E402
 from dst_libp2p_test_node_tpu.runtime.simulator import (  # noqa: E402
     ExperimentConfig, Simulator)
+from dst_libp2p_test_node_tpu.runtime.summarize import sanitize_nonfinite  # noqa: E402
 
 N = 10_000               # mainnet consensus nodes: order 10^4
 BLOCK_BYTES = 128_000    # ~100 KB average pre-blob block, rounded up
@@ -159,10 +160,11 @@ def main() -> None:
         },
         "ours": ours,
     }
-    print(json.dumps(out, indent=2))
+    out = sanitize_nonfinite(out)
+    print(json.dumps(out, indent=2, allow_nan=False))
     if a.write:
         with open(a.write, "w") as f:
-            json.dump(out, f, indent=2)
+            json.dump(out, f, indent=2, allow_nan=False)
             f.write("\n")
 
 

@@ -51,6 +51,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from dst_libp2p_test_node_tpu.config.topology import TopoParams  # noqa: E402
 from dst_libp2p_test_node_tpu.runtime.simulator import (  # noqa: E402
     ExperimentConfig, Simulator)
+from dst_libp2p_test_node_tpu.runtime.summarize import sanitize_nonfinite  # noqa: E402
 
 LOSS = 0.01           # run.sh positional 9 / topogen -l (run.sh:33)
 STRESS = 0.20         # rate at which the latency tails separate measurably
@@ -127,10 +128,11 @@ def main() -> None:
         },
         "runs": rows,
     }
-    print(json.dumps(out, indent=2))
+    out = sanitize_nonfinite(out)
+    print(json.dumps(out, indent=2, allow_nan=False))
     if a.write:
         with open(a.write, "w") as f:
-            json.dump(out, f, indent=2)
+            json.dump(out, f, indent=2, allow_nan=False)
             f.write("\n")
 
 

@@ -35,6 +35,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from dst_libp2p_test_node_tpu.config.topology import TopoParams  # noqa: E402
 from dst_libp2p_test_node_tpu.runtime.simulator import (  # noqa: E402
     EVENT_LOOP_MS, MUXER_PROC_MS, ExperimentConfig, Simulator)
+from dst_libp2p_test_node_tpu.runtime.summarize import sanitize_nonfinite  # noqa: E402
 
 N = 100
 MSG_SIZE = 15000
@@ -106,13 +107,14 @@ def main() -> None:
              "config": {"peers": N, "msg_size_bytes": MSG_SIZE,
                         "messages": MESSAGES, "connect_to": 10, "seed": 0,
                         "event_loop_ms": EVENT_LOOP_MS}}
-    print(json.dumps(table, indent=2))
+    table = sanitize_nonfinite(table)
+    print(json.dumps(table, indent=2, allow_nan=False))
     if a.write:
         with open(a.write) as f:
             artifact = json.load(f)
         artifact["muxer_sensitivity"] = table
         with open(a.write, "w") as f:
-            json.dump(artifact, f, indent=2)
+            json.dump(sanitize_nonfinite(artifact), f, indent=2, allow_nan=False)
             f.write("\n")
 
 

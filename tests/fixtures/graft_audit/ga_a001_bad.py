@@ -1,0 +1,12 @@
+"""Golden violation for GA-A001: numpy math applied to a traced value.
+
+Never imported — parsed by tests/test_graft_audit.py via lint_source.
+"""
+import jax
+import numpy as np
+
+
+@jax.jit
+def decay_scores(scores):
+    # np.exp runs on host and silently materializes the tracer
+    return scores * np.exp(-0.1 * scores)

@@ -1,0 +1,11 @@
+"""Clean twin of ga_a001_bad: jnp math on the tracer, np only on statics."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+TABLE = np.exp(-0.1 * np.arange(8.0))  # host math on a host constant is fine
+
+
+@jax.jit
+def decay_scores(scores):
+    return scores * jnp.exp(-0.1 * scores)

@@ -1,0 +1,9 @@
+"""Golden violation for GA-A002: host coercion (float()) of a traced value."""
+import jax
+
+
+@jax.jit
+def mean_delay(delays):
+    total = delays.sum()
+    # float() forces a concrete value out of the tracer — ConcretizationError
+    return float(total) / delays.shape[0]

@@ -1,0 +1,9 @@
+"""Clean twin of ga_a002_bad: dtype cast stays on device; shape is static."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def mean_delay(delays):
+    total = delays.sum().astype(jnp.float32)
+    return total / float(delays.shape[0])  # shape is static — host float ok

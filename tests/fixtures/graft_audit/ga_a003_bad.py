@@ -1,0 +1,10 @@
+"""Golden violation for GA-A003: python `if` branching on a traced value."""
+import jax
+
+
+@jax.jit
+def clamp_budget(budget, cap):
+    # `if` on a tracer raises TracerBoolConversionError under jit
+    if budget > cap:
+        return cap
+    return budget

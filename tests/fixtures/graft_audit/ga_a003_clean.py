@@ -1,0 +1,8 @@
+"""Clean twin of ga_a003_bad: the branch is a device-side select."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def clamp_budget(budget, cap):
+    return jnp.where(budget > cap, cap, budget)

@@ -1,0 +1,9 @@
+"""Golden violation for GA-A004: host sync on a traced value in a jit scope."""
+import jax
+
+
+@jax.jit
+def publish_round(state, msgs):
+    out = state + msgs
+    out.block_until_ready()  # host sync inside a traced scope
+    return out

@@ -1,0 +1,13 @@
+"""Clean twin of ga_a004_bad: the sync happens outside the traced scope."""
+import jax
+
+
+@jax.jit
+def publish_round(state, msgs):
+    return state + msgs
+
+
+def timed_publish(state, msgs):
+    out = publish_round(state, msgs)
+    out.block_until_ready()  # outside jit: a legitimate timing barrier
+    return out

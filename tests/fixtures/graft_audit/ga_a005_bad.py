@@ -1,0 +1,8 @@
+"""Golden violation for GA-A005: json writer reachable by non-finite floats."""
+import json
+
+
+def write_stats(stats, path):
+    with open(path, "w") as f:
+        # neither allow_nan=False nor sanitize_nonfinite: NaN poisons the file
+        json.dump(stats, f, indent=2)

@@ -1,0 +1,9 @@
+"""Clean twin of ga_a005_bad: sanitized payload + strict encoder."""
+import json
+
+from dst_libp2p_test_node_tpu.runtime.summarize import sanitize_nonfinite
+
+
+def write_stats(stats, path):
+    with open(path, "w") as f:
+        json.dump(sanitize_nonfinite(stats), f, indent=2, allow_nan=False)

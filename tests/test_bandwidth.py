@@ -13,8 +13,6 @@ import numpy as np
 import pytest
 
 from dst_libp2p_test_node_tpu.runtime.bandwidth import (
-    CTRL_PKT_BYTES,
-    HDR_BYTES,
     MSS_BYTES,
     PeerTraffic,
     report,

@@ -243,7 +243,6 @@ class TestMetricsProjection:
         # every GRAFT/PRUNE sent is received by its counterpart: the four
         # per-peer counters conserve network-wide, and the exporter fills
         # BOTH the broadcast_* and received_* families (metrics.go:328-336)
-        import jax.numpy as jnp
         import numpy as np
 
         from dst_libp2p_test_node_tpu.ops.graph import build_connection_graph

@@ -1,11 +1,10 @@
 import numpy as np
 import jax.numpy as jnp
-import pytest
 
 from dst_libp2p_test_node_tpu.config.topology import Topology, TopoParams
 from dst_libp2p_test_node_tpu.ops.graph import build_connection_graph
 from dst_libp2p_test_node_tpu.ops.heartbeat import run_heartbeats
-from dst_libp2p_test_node_tpu.ops.disseminate import disseminate, INF
+from dst_libp2p_test_node_tpu.ops.disseminate import disseminate
 from dst_libp2p_test_node_tpu.ops.state import SimParams, init_state, graph_arrays
 
 

@@ -1,6 +1,5 @@
 import pytest
 
-from dst_libp2p_test_node_tpu.config import env as env_mod
 from dst_libp2p_test_node_tpu.config.env import (
     GossipSubParams,
     get_peer_details,

@@ -71,7 +71,6 @@ def _dense_reference(graph, lat_edge, tx_ms, send_mask, rank, k_p,
     txm = np.asarray(tx_ms)
     sm = np.asarray(send_mask)
     rk = np.asarray(rank)
-    kp = np.asarray(k_p)
     gt = np.asarray(g_tgt)
     gf = np.asarray(g_off)
     ph = np.asarray(hb_phase)

@@ -8,7 +8,6 @@ replenished to D when stale members drop out, and expired wholesale by the
 heartbeat once the TTL passes without a publish.
 """
 
-import jax.numpy as jnp
 import numpy as np
 
 from dst_libp2p_test_node_tpu.config.env import GossipSubParams

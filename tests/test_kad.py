@@ -62,7 +62,6 @@ def test_insert_invariants():
     allp = jnp.broadcast_to(owners[None, :], (n, n))
     st = kad.rtable_insert(st, owners, allp)
     rt = np.asarray(st.rtable)
-    keys = np.asarray(st.keys)
     for p in range(n):
         entries = rt[p][rt[p] >= 0]
         # no self, no duplicates

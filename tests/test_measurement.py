@@ -12,7 +12,6 @@ from dst_libp2p_test_node_tpu.runtime.logemit import LatenciesWriter, stdout_lin
 from dst_libp2p_test_node_tpu.runtime.native_logemit import format_block
 from dst_libp2p_test_node_tpu.runtime.summarize import (
     parse_latencies,
-    report,
     summarize,
 )
 
@@ -63,7 +62,6 @@ def test_parse_accepts_peer_and_pod_naming():
 
 
 def test_summarize_small():
-    lines = []
     w = LatenciesWriter()
     w.add_message(42, np.array([1, 2, 3]), np.array([50, 150, 250]))
     w.add_message(43, np.array([1, 2]), np.array([100, 300]))

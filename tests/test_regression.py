@@ -7,7 +7,6 @@ single network size; the assertions slice it from different angles."""
 import numpy as np
 import pytest
 
-from dst_libp2p_test_node_tpu.ops import kad
 from dst_libp2p_test_node_tpu.runtime.regression_runtime import (
     MESH_PING_TIMEOUT_MS,
     RegressionConfig,

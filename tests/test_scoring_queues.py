@@ -3,7 +3,6 @@
 
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from dst_libp2p_test_node_tpu.ops.disseminate import disseminate
 from dst_libp2p_test_node_tpu.ops.graph import build_connection_graph
