@@ -296,6 +296,49 @@ def main() -> None:
         "censored publish broke honest delivery")
     assert np.isfinite(attack_trials_per_s) and attack_trials_per_s > 0.0
 
+    # mesh-repair probe (ops/repair.py): one recovery window — eviction +
+    # PX + re-dial armed — run from the post-attack state, timed min-of-3
+    # as a single repair trial. BENCH tracks repair_trials_per_s alongside
+    # attack_trials_per_s: the recovery scan carries the CONNECTION GRAPH
+    # (nothing hoists), so its round cost bounds the dynamic-graph path.
+    from dst_libp2p_test_node_tpu.ops.repair import (
+        RepairParams, run_recovery_heartbeats,
+    )
+
+    params_repair = RepairParams(
+        evict=True, px=True, redial=True).apply(params_attack)
+    REPAIR_HB = 10
+
+    def _repair_trial():
+        return run_recovery_heartbeats(
+            s_a, a["conns"], a["rev"], a["out_mask"], att_j, params_repair,
+            REPAIR_HB, publisher=4)
+
+    (s_r, cn_r, _rv_r, _om_r), obs_r = _repair_trial()
+    jax.block_until_ready(cn_r)                     # compile
+    repair_s = np.inf
+    for _ in range(3):
+        t1 = time.time()
+        (s_r, cn_r, _rv_r, _om_r), obs_r = _repair_trial()
+        jax.block_until_ready(cn_r)
+        repair_s = min(repair_s, time.time() - t1)
+    repair_trials_per_s = 1.0 / repair_s
+    evictions_total = int(np.asarray(s_r.evictions).sum())
+    redials_total = int(np.asarray(s_r.redials).sum())
+    att_share_attack = float(np.asarray(obs_a["attacker_mesh_share"])[-1])
+    att_share_repair = float(np.asarray(obs_r["attacker_mesh_share"])[-1])
+    # sanity gates, same style as above: a repair window that evicts
+    # nothing (the post-attack scores sit far below the threshold) or
+    # leaves the attacker mesh share where the attack left it measured a
+    # DCE'd or disarmed path
+    assert evictions_total > 0, (
+        "mesh_evictions_total == 0 after the repair window: the eviction "
+        "branch never fired on a state full of graylisted attackers")
+    assert att_share_repair <= att_share_attack, (
+        f"attacker mesh share rose {att_share_attack} -> "
+        f"{att_share_repair} across the repair window")
+    assert np.isfinite(repair_trials_per_s) and repair_trials_per_s > 0.0
+
     rounds = MESSAGES * per_burst
     value = N_PEERS * rounds / wall
     # coverage and percentiles over ALL timed messages, not the last one's
@@ -373,6 +416,17 @@ def main() -> None:
                 "honest_coverage": round(cov_attack, 4),
                 "attacker_score": round(att_score, 2),
                 "graylisted_frac": round(gray_frac, 4),
+            },
+            # mesh-repair probe: one recovery window (eviction + PX +
+            # re-dial, REPAIR_HB heartbeats with the graph in the scan
+            # carry) from the post-attack state, min-of-3 trials
+            "repair_trials_per_s": round(repair_trials_per_s, 3),
+            "repair": {
+                "recovery_heartbeats": REPAIR_HB,
+                "trial_s": round(repair_s, 3),
+                "mesh_evictions_total": evictions_total,
+                "redials_total": redials_total,
+                "attacker_mesh_share_after": round(att_share_repair, 4),
             },
             "p50_ms": float(np.percentile(delays[ok], 50)),
             "p99_ms": float(np.percentile(delays[ok], 99)),

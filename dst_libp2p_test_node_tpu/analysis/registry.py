@@ -21,6 +21,12 @@ The registered surface mirrors the BENCH hot paths exactly:
                           intentionally trades these conds for select_n —
                           that form is deliberately NOT registered with a
                           cond contract; see docs/ARCHITECTURE.md §9)
+  heartbeat_step/evict    the opt-in mesh-repair heartbeat (eviction +
+                          PX-capture branches armed: 6 surviving conds)
+  repair/recovery_window  the post-attack repair scan (ops/repair.py) with
+                          the connection graph in the carry; checkified to
+                          preserve the reverse-slot involution over the
+                          mutated graph
   kad/find_node           the DHT lookup scan
   multitopic/disseminate  the T*N block-diagonal publish
 """
@@ -64,10 +70,10 @@ def _disseminate_spec(**params_over) -> TraceSpec:
                     payload_bytes=15000))
 
 
-def _heartbeat_spec(fn_name: str) -> TraceSpec:
+def _heartbeat_spec(fn_name: str, **params_over) -> TraceSpec:
     from ..ops import heartbeat
 
-    g, params, state, a, _ = _single_topic()
+    g, params, state, a, _ = _single_topic(**params_over)
     fn = getattr(heartbeat, fn_name)
     kwargs = {"params": params}
     if fn_name == "run_heartbeats":
@@ -75,6 +81,28 @@ def _heartbeat_spec(fn_name: str) -> TraceSpec:
     return TraceSpec(
         fn=fn, args=(state, a["conns"], a["rev"], a["out_mask"]),
         kwargs=kwargs)
+
+
+# the armed-defense overrides every repair entrypoint traces under: the
+# repair branches gate on scores, so auditing them against the default
+# (thresholds compiled out) config would certify a path nobody runs
+_ARMED = dict(slow_weight=-10.0, slow_decay=0.9, gossip_threshold=-10.0,
+              publish_threshold=-20.0, graylist_threshold=-50.0)
+_REPAIR = dict(evict=True, px=True, redial=True, **_ARMED)
+
+
+def _repair_spec() -> TraceSpec:
+    import jax.numpy as jnp
+
+    from ..ops.adversary import attacker_cohort
+    from ..ops.repair import run_recovery_heartbeats
+
+    g, params, state, a, _ = _single_topic(**_REPAIR)
+    att = jnp.asarray(attacker_cohort(params.n, 0.25, seed=1))
+    return TraceSpec(
+        fn=run_recovery_heartbeats,
+        args=(state, a["conns"], a["rev"], a["out_mask"], att),
+        kwargs=dict(params=params, steps=4, publisher=3))
 
 
 def _attack_spec() -> TraceSpec:
@@ -181,6 +209,50 @@ def _checkify_heartbeat() -> None:
     err.throw()
 
 
+def _checkify_repair() -> None:
+    """Runtime half of the recovery contract: after a repair window the
+    reverse-slot involution still holds over the MUTATED graph — every
+    committed dial extended conns/rev consistently on both sides — and the
+    repair counters are consistent (a PX graft is a graft)."""
+    import jax.numpy as jnp
+    from jax.experimental import checkify
+
+    from ..ops.adversary import attacker_cohort
+    from ..ops.heartbeat import run_heartbeats
+    from ..ops.repair import run_recovery_heartbeats
+
+    g, params, state, a, _ = _single_topic(**_REPAIR)
+    state = run_heartbeats(state, a["conns"], a["rev"], a["out_mask"],
+                           params, 8)
+    att = jnp.asarray(attacker_cohort(params.n, 0.25, seed=1))
+    # force repair activity: pre-starve by evicting the attacker edges via
+    # a hostile penalty so the dial path actually runs under the check
+    state = state.replace(slow_penalty=jnp.where(
+        att[jnp.clip(a["conns"], 0)] & (a["conns"] >= 0),
+        jnp.float32(100.0), state.slow_penalty))
+    (s2, cn, rv, om), _obs = run_recovery_heartbeats(
+        state, a["conns"], a["rev"], a["out_mask"], att, params,
+        steps=8, publisher=3)
+
+    def prog(cn, rv, px_grafts, redials, grafts0, grafts1):
+        me = jnp.arange(cn.shape[0], dtype=cn.dtype)[:, None]
+        back = cn[jnp.clip(cn, 0), rv]
+        checkify.check(
+            jnp.all(jnp.where(cn >= 0, back == me, True)),
+            "reverse-slot involution broken after repair window")
+        checkify.check(
+            jnp.all(rv >= 0) & jnp.all(rv < cn.shape[1]),
+            "rev slot out of range after repair window")
+        checkify.check(
+            (px_grafts + redials).sum() <= (grafts1 - grafts0).sum() * 2 + 1,
+            "repair counters inconsistent with graft accounting")
+        return cn
+
+    err, _ = checkify.checkify(prog)(
+        cn, rv, s2.px_grafts, s2.redials, state.grafts, s2.grafts)
+    err.throw()
+
+
 def _checkify_disseminate() -> None:
     """Runtime half of the publish contract: delays are non-negative where
     received, and the bounded-mode wait bar is finite (json-safe)."""
@@ -260,6 +332,25 @@ def default_contracts() -> list[EntrypointContract]:
             feedback=[(_first_out, _state_arg_of)],
             notes="UNBATCHED campaign window; the vmapped trial batch "
                   "intentionally elides these conds and is not registered"),
+        EntrypointContract(
+            name="heartbeat_step/evict",
+            build=lambda: _heartbeat_spec("heartbeat_step", **_REPAIR),
+            expected_conds=6,
+            donate=(0,),
+            notes="opt-in repair branches: the 4 default skips plus the "
+                  "eviction and PX-capture conds must SURVIVE (a select_n "
+                  "here would pay both branches in the steady state)"),
+        EntrypointContract(
+            name="repair/recovery_window",
+            build=_repair_spec,
+            expected_conds=7,
+            # the WHOLE carry feeds back: (state, conns, rev, out_mask) —
+            # the dynamic graph is a loop-carried value, not a constant
+            feedback=[(_first_out, lambda spec: spec.args[:4])],
+            runtime_check=_checkify_repair,
+            notes="recovery scan: 6 armed-heartbeat conds + the repair "
+                  "controller's single action cond, all inside the scan "
+                  "body; the graph arrays ride the carry"),
         EntrypointContract(
             name="kad/find_node",
             build=_kad_spec,

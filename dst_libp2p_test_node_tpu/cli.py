@@ -350,6 +350,24 @@ def cmd_attack(argv: list[str]) -> int:
                    "(peers must divide evenly by the device count)")
     p.add_argument("--checkpoint-dir", default=None,
                    help="snapshot each trial's post-window state here")
+    # mesh-repair subsystem (ops/repair.py): the recovery window + knobs
+    p.add_argument("--recovery-heartbeats", type=int, default=0,
+                   help="post-attack repair rounds before the publish "
+                   "schedule (0 = no recovery window)")
+    p.add_argument("--evict", action="store_true",
+                   help="arm score-based mesh eviction in the recovery "
+                   "window's heartbeats")
+    p.add_argument("--eviction-threshold", type=float, default=-50.0,
+                   help="PRUNE mesh members scoring below this (<= 0)")
+    p.add_argument("--px", action="store_true",
+                   help="peer exchange on PRUNE: pruned peers learn "
+                   "score-ranked candidates and may GRAFT/dial them")
+    p.add_argument("--px-count", type=int, default=6,
+                   help="candidate ids carried per PRUNE")
+    p.add_argument("--redial", action="store_true",
+                   help="starved peers (mesh degree < D_lo for "
+                   "--redial-patience heartbeats) dial new connections")
+    p.add_argument("--redial-patience", type=int, default=3)
     p.add_argument("--json", default=None,
                    help="write the campaign result as strict JSON here")
     p.add_argument("--metrics-out", default=None,
@@ -358,6 +376,7 @@ def cmd_attack(argv: list[str]) -> int:
     a = p.parse_args(argv)
 
     from .ops.adversary import AdversaryParams
+    from .ops.repair import RepairParams
     from .runtime.campaign import (
         CampaignConfig, attack_gossipsub, run_campaign)
     from .runtime.simulator import ExperimentConfig
@@ -389,6 +408,11 @@ def cmd_attack(argv: list[str]) -> int:
         attack_heartbeats=a.attack_heartbeats,
         vmap_trials=not a.no_vmap,
         checkpoint_dir=a.checkpoint_dir,
+        recovery_heartbeats=a.recovery_heartbeats,
+        repair=RepairParams(
+            evict=a.evict, eviction_threshold=a.eviction_threshold,
+            px=a.px, px_count=a.px_count,
+            redial=a.redial, redial_patience=a.redial_patience),
     )
     mesh = None
     if a.mesh:

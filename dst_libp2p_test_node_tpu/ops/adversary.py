@@ -18,6 +18,18 @@ as a benign heartbeat and NOTHING here loops over peers in Python:
                       to every valid edge each heartbeat; honest peers IWANT
                       the unseen ids and the answers never come (broken
                       IWANT promises -> the same penalty counter).
+  iwant_spam          the amplification dual: attacker rows REQUEST
+                      `spam_iwants_per_hb` ids per valid edge each
+                      heartbeat. Honest peers answer requests from
+                      not-yet-graylisted edges, and each answer occupies
+                      the shared uplink for `iwant_answer_ms` — the
+                      answer-queue exhaustion lands in
+                      SimState.uplink_free_ms, the SAME carry the
+                      dissemination fixpoint serializes publishes through,
+                      so spam directly delays the next publish. Unsolicited
+                      IWANTs accrue the penalty counter once per spammed
+                      edge per heartbeat, so scoring eventually stops the
+                      bleeding (a graylisted requester is refused).
   censorship          in-mesh attackers silently refuse to forward: a
                       per-edge DELIVERY drop mask (censor_mask) folded into
                       disseminate's `survive` exactly like the graylist
@@ -61,6 +73,7 @@ from .state import SimParams, SimState
 SCENARIOS = (
     "sybil_graft_flood",
     "ihave_spam",
+    "iwant_spam",
     "censorship",
     "eclipse_publisher",
     "cold_boot_join",
@@ -80,6 +93,11 @@ class AdversaryParams:
     censor_penalty: float = 1.0
     # bogus IHAVE ids announced per valid edge per heartbeat (ihave_spam)
     spam_ihaves_per_hb: int = 8
+    # unsolicited IWANT ids requested per valid edge per heartbeat
+    # (iwant_spam); each answered id occupies the victim's uplink for
+    # iwant_answer_ms (the amplification factor)
+    spam_iwants_per_hb: int = 16
+    iwant_answer_ms: float = 2.0
 
     def validate(self) -> None:
         if self.scenario not in SCENARIOS:
@@ -89,6 +107,10 @@ class AdversaryParams:
             raise ValueError("violation_penalty must be > 0, censor_penalty >= 0")
         if self.spam_ihaves_per_hb < 1:
             raise ValueError("spam_ihaves_per_hb must be >= 1")
+        if self.spam_iwants_per_hb < 1:
+            raise ValueError("spam_iwants_per_hb must be >= 1")
+        if self.iwant_answer_ms < 0.0:
+            raise ValueError("iwant_answer_ms must be >= 0")
 
     # scenario -> active behaviors (all derived, keeping the dataclass a
     # pure static key: one flag per scenario would multiply trace keys)
@@ -100,6 +122,10 @@ class AdversaryParams:
     @property
     def ihave_spam(self) -> bool:
         return self.scenario == "ihave_spam"
+
+    @property
+    def iwant_spam(self) -> bool:
+        return self.scenario == "iwant_spam"
 
     @property
     def eclipse(self) -> bool:
@@ -160,15 +186,32 @@ def heartbeats_to_graylist(adv: AdversaryParams, params: SimParams) -> float:
     slow_weight*c_k <= graylist_threshold, i.e. c_k >= G/w (both negative).
     Violations start on round 2 for graft-flood (round 1's grafts are
     accepted into empty backoff/mesh; every re-graft after violates) and
-    round 1 for ihave_spam. Returns inf when the steady-state counter
-    p/(1-d) can never reach the requirement — the campaign should treat
-    that as a config error, not wait forever."""
+    round 1 for ihave_spam / iwant_spam. Returns inf when the steady-state
+    counter p/(1-d) can never reach the requirement — the campaign should
+    treat that as a config error, not wait forever.
+
+    INVARIANT UNDER EVICTION (params.evict). The budget does not move when
+    the eviction branch is armed, because eviction swaps WHICH disjunct of
+    the violation predicate fires without changing its truth value. Take
+    graft-flood: pre-eviction, a flooded edge violates through
+    `rx & mesh` (the re-GRAFT of a meshed edge). The eviction PRUNE removes
+    the edge from the mesh but — through `_reciprocal_view`, both sides —
+    writes `backoff_until = t + prune_backoff_ms`, so from the next round
+    the SAME edge violates through `rx & (backoff_until > t)` instead
+    (re-GRAFT of a backed-off edge). Since prune_backoff_ms (60 s default)
+    spans hundreds of heartbeats and a fresh flood re-arms it, the accrual
+    cadence — one violation_penalty per flooded edge per heartbeat — is
+    identical, and the c_k = d*c_{k-1} + p recurrence (hence this closed
+    form) holds with eviction on or off. tests/test_repair.py pins this by
+    bit-comparing the graylisted_frac curves across both modes. The spam
+    scenarios never consult mesh/backoff in their violation predicate, so
+    they are trivially invariant."""
     if params.slow_weight >= 0.0:
         return math.inf  # thresholds_can_bind is False: defenses compiled out
     c_req = params.graylist_threshold / params.slow_weight
     p = adv.violation_penalty
     d = params.slow_decay
-    lead_in = 1.0 if adv.ihave_spam else 2.0
+    lead_in = 1.0 if (adv.ihave_spam or adv.iwant_spam) else 2.0
     if c_req <= p:
         return lead_in  # first accrual already crosses
     rhs = 1.0 - c_req * (1.0 - d) / p
@@ -228,6 +271,7 @@ def adversary_round(
 
     mesh = state.mesh_mask
     slow_penalty = state.slow_penalty
+    uplink_free_ms = state.uplink_free_ms
     grafts, grafts_rx = state.grafts, state.grafts_rx
     ihave_tx, ihave_rx = state.ihave_tx, state.ihave_rx
     iwant_tx, iwant_rx = state.iwant_tx, state.iwant_rx
@@ -266,20 +310,70 @@ def adversary_round(
         slow_penalty = slow_penalty + jnp.where(
             rx_ann, jnp.float32(adv.violation_penalty), 0.0)
 
+    if adv.iwant_spam:
+        # unsolicited IWANT requests on every valid attacker edge. The
+        # honest side answers requests from edges it has not graylisted yet
+        # (scored on the PRE-round counter: the refusal reacts one round
+        # late, like a real score cache), and every answered id serializes
+        # `iwant_answer_ms` onto the victim's shared uplink — the
+        # amplification: requests are tiny, answers are messages. The
+        # unsolicited request itself is the violation (penalty per spammed
+        # edge per heartbeat), so scoring caps the damage.
+        req = att_row
+        rx_req = reciprocal_pull_bool(req, conns, rev, batch_factor)
+        k = jnp.int32(adv.spam_iwants_per_hb)
+        sc0 = state.score(params)
+        serve = rx_req & (sc0 >= params.graylist_threshold)
+        served = serve.sum(axis=-1, dtype=jnp.int32) * k   # answers sent
+        iwant_tx = iwant_tx + req.sum(axis=-1, dtype=jnp.int32) * k
+        iwant_rx = iwant_rx + rx_req.sum(axis=-1, dtype=jnp.int32) * k
+        uplink_free_ms = jnp.where(
+            served > 0,
+            jnp.maximum(uplink_free_ms, t)
+            + served.astype(jnp.float32) * jnp.float32(adv.iwant_answer_ms),
+            uplink_free_ms)
+        slow_penalty = slow_penalty + jnp.where(
+            rx_req, jnp.float32(adv.violation_penalty), 0.0)
+
     new_state = state.replace(
         mesh_mask=mesh, slow_penalty=slow_penalty,
+        uplink_free_ms=uplink_free_ms,
         grafts=grafts, grafts_rx=grafts_rx,
         ihave_tx=ihave_tx, ihave_rx=ihave_rx,
         iwant_tx=iwant_tx, iwant_rx=iwant_rx,
     )
 
-    # -- per-round observables (scalars; the scan stacks them) ---------------
-    sc = new_state.score(params)
+    obs = attack_observables(new_state, conns, rev, attacker, params,
+                             batch_factor=batch_factor, valid=valid)
+    return new_state, obs
+
+
+def attack_observables(
+    state: SimState,
+    conns: jnp.ndarray,
+    rev: jnp.ndarray,
+    attacker: jnp.ndarray,
+    params: SimParams,
+    batch_factor: int = 1,
+    valid: jnp.ndarray | None = None,
+):
+    """The per-round scalar observables the campaign's engagement/recovery
+    metrics are built from (the scan stacks them into (steps,) curves).
+    Shared by adversary_round and the recovery runner (ops/repair.py) so
+    attack-window and recovery-window curves concatenate seamlessly."""
+    if valid is None:
+        nbr_ok = neighbor_pull_bool(
+            state.alive & state.subscribed, conns, rev, batch_factor)
+        valid = ((conns >= 0) & state.alive[:, None] & nbr_ok
+                 & state.subscribed[:, None])
+    honest = ~attacker & state.alive & state.subscribed
+    mesh = state.mesh_mask
+    sc = state.score(params)
     att_nbr = neighbor_pull_bool(attacker, conns, rev, batch_factor)
     h_att_edge = valid & att_nbr & honest[:, None]   # honest view of attackers
     n_e = jnp.maximum(h_att_edge.sum(), 1)
     f32 = jnp.float32
-    obs = {
+    return {
         # fraction of honest->attacker edges the receiver graylists
         "graylisted_frac": (h_att_edge
                             & (sc < params.graylist_threshold)).sum() / f32(n_e),
@@ -292,7 +386,6 @@ def adversary_round(
             (mesh & honest[:, None]).sum()
             / f32(jnp.maximum(honest.sum(), 1))),
     }
-    return new_state, obs
 
 
 @partial(jax.jit, static_argnames=("params", "adv", "steps", "batch_factor"))

@@ -437,7 +437,14 @@ def disseminate(
     # pull is publish-invariant between membership changes — callers that
     # loop over publishes precompute it (Simulator/bench maintain it and
     # invalidate on churn or subscription flips), saving one full
-    # row-gather pass per publish.
+    # row-gather pass per publish. DYNAMIC-GRAPH CONTRACT: a hoisted
+    # valid_edge (and lat_edge/loss_edge/ans_tables) is a pure function of
+    # conns/rev — if the repair controller's dial path extended the graph
+    # (ops/repair.py), the caller must re-derive all of them against the
+    # mutated arrays (Simulator.rebind_graph) and the warm-start carry in
+    # state.warm_offset_ms must already be INF (repair_round writes it on
+    # any committed dial); passing stale tables here silently publishes
+    # over the pre-repair edge set.
     has = conns >= 0
     if valid_edge is not None:
         valid = valid_edge

@@ -24,7 +24,7 @@ import jax
 import jax.numpy as jnp
 
 from .pull import neighbor_pull_bool, reciprocal_pull_bool
-from .state import SimParams, SimState
+from .state import PX_POOL_WIDTH, SimParams, SimState
 
 BIG = jnp.float32(1e30)
 
@@ -212,7 +212,7 @@ def heartbeat_step(
     # a cond: at steady state no row exceeds D_high and the step skips it.
     over = deg2 > params.d_high
 
-    def do_prune(mesh):
+    def _prune_sel(mesh):
         rand_keep = jax.random.uniform(k_keep, (n, c))
         scores = get_scores()
         # rank by descending score (random tiebreak) among mesh members
@@ -237,14 +237,102 @@ def heartbeat_step(
             t + params.prune_backoff_ms, state.backoff_until)
         return (mesh & ~pruned_by_peer, backoff,
                 pruned.sum(axis=-1, dtype=jnp.int32),
-                pruned_by_peer.sum(axis=-1, dtype=jnp.int32))
+                pruned_by_peer.sum(axis=-1, dtype=jnp.int32),
+                pruned_by_peer)
 
-    mesh, backoff, prune_tx_inc, prune_rx_inc = jax.lax.cond(
-        over.any(),
-        do_prune,
-        lambda m: (m, state.backoff_until, zeros_n, zeros_n),
-        mesh,
-    )
+    pruned_rx = None
+    if params.px:
+        # PX needs the received-PRUNE edge set out of the branch; the extra
+        # output exists only on the opt-in trace (ops/repair.py)
+        mesh, backoff, prune_tx_inc, prune_rx_inc, pruned_rx = jax.lax.cond(
+            over.any(),
+            _prune_sel,
+            lambda m: (m, state.backoff_until, zeros_n, zeros_n,
+                       jnp.zeros((n, c), dtype=bool)),
+            mesh,
+        )
+    else:
+        mesh, backoff, prune_tx_inc, prune_rx_inc = jax.lax.cond(
+            over.any(),
+            lambda m: _prune_sel(m)[:4],
+            lambda m: (m, state.backoff_until, zeros_n, zeros_n),
+            mesh,
+        )
+
+    # -- score eviction (mesh repair; opt-in via params.evict) ---------------
+    # v1.1 mesh maintenance also drops members whose score sank below a
+    # floor, with PRUNE + backoff on both sides (go-libp2p-pubsub prunes
+    # negative-score peers before rebalancing). Statically gated so the
+    # default step carries none of it; inside the gate a separate lax.cond
+    # keeps the healthy steady state (nobody under the floor) probe-cheap.
+    # Reciprocity reuses _reciprocal_view — identical PRUNE semantics to
+    # _prune_sel. The predicate pays one score materialization per step;
+    # that is the documented cost of arming eviction.
+    ev_tx_inc = ev_rx_inc = None
+    evict_fired = None
+    ev_rx_edges = None
+    if params.evict:
+        ev_cand = mesh & (get_scores() < params.eviction_threshold)
+        evict_fired = ev_cand.any()
+
+        def do_evict(mesh, backoff):
+            ev_rx = _reciprocal_view(ev_cand, conns, rev, batch_factor)
+            new_backoff = jnp.where(
+                ev_cand | ev_rx, t + params.prune_backoff_ms, backoff)
+            return (mesh & ~ev_cand & ~ev_rx, new_backoff,
+                    ev_cand.sum(axis=-1, dtype=jnp.int32),
+                    ev_rx.sum(axis=-1, dtype=jnp.int32),
+                    ev_rx)
+
+        mesh, backoff, ev_tx_inc, ev_rx_inc, ev_rx_edges = jax.lax.cond(
+            evict_fired,
+            do_evict,
+            lambda m, b: (m, b, zeros_n, zeros_n,
+                          jnp.zeros((n, c), dtype=bool)),
+            mesh, backoff,
+        )
+
+    # -- PX on PRUNE (mesh repair; opt-in via params.px) ---------------------
+    # Every PRUNE (degree rebalance or eviction) carries up to px_count
+    # candidate peer ids: the pruner's best-scored valid neighbors ("honest"
+    # proxied by score >= 0 — penalized/graylisted peers are never
+    # advertised). The prunee stores them in its px_pool; acting on them
+    # (graft / dial) is the repair controller's job next heartbeat
+    # (ops/repair.py repair_round). Deterministic slot-index tiebreak: no
+    # PRNG is consumed, keeping the default key schedule untouched.
+    px_pool = None
+    if params.px:
+        got_pruned = pruned_rx
+        if ev_rx_edges is not None:
+            got_pruned = got_pruned | ev_rx_edges
+
+        def do_px(pool):
+            scores = get_scores()
+            elig = valid & (scores >= 0.0)
+            prio = (jnp.where(elig, -scores, BIG)
+                    + 1e-4 * jnp.arange(c, dtype=jnp.float32))
+            w = min(PX_POOL_WIDTH, c)
+            order = jnp.argsort(prio, axis=-1)[:, :w]
+            take_ok = (jnp.take_along_axis(elig, order, axis=-1)
+                       & (jnp.arange(w) < params.px_count))
+            cand = jnp.where(
+                take_ok, jnp.take_along_axis(conns, order, axis=-1), -1)
+            if w < PX_POOL_WIDTH:
+                cand = jnp.pad(cand, ((0, 0), (0, PX_POOL_WIDTH - w)),
+                               constant_values=-1)
+            # the prunee reads the advert off ONE pruning edge (the lowest
+            # pruning slot) — one row-gather through the involution, same
+            # shape economics as _reciprocal_view
+            got = got_pruned.any(axis=-1)
+            i0 = jnp.argmax(got_pruned, axis=-1)
+            pruner = jnp.take_along_axis(conns, i0[:, None], axis=1)[:, 0]
+            advert = cand[jnp.clip(pruner, 0)]
+            advert = jnp.where(
+                advert == jnp.arange(n, dtype=jnp.int32)[:, None], -1, advert)
+            return jnp.where(got[:, None], advert, pool)
+
+        px_pool = jax.lax.cond(
+            got_pruned.any(), do_px, lambda p: p, state.px_pool)
 
     # -- opportunistic grafting (v1.1, main.nim:292): when the MEDIAN mesh
     # score sinks below the threshold, graft up to 2 peers scoring above the
@@ -310,6 +398,16 @@ def heartbeat_step(
         state.fanout_mask,
     )
 
+    prunes_new = state.prunes + prune_tx_inc
+    prunes_rx_new = state.prunes_rx + prune_rx_inc
+    repair_extra = {}
+    if params.evict:
+        # an eviction IS a PRUNE control message; count it in both ledgers
+        prunes_new = prunes_new + ev_tx_inc
+        prunes_rx_new = prunes_rx_new + ev_rx_inc
+        repair_extra["evictions"] = state.evictions + ev_tx_inc
+    if params.px:
+        repair_extra["px_pool"] = px_pool
     new_state = state.replace(
         mesh_mask=mesh,
         fanout_mask=fanout,
@@ -322,8 +420,9 @@ def heartbeat_step(
         key=key,
         grafts=state.grafts + graft_tx_inc + og_tx_inc,
         grafts_rx=state.grafts_rx + graft_rx_inc + og_rx_inc,
-        prunes=state.prunes + prune_tx_inc,
-        prunes_rx=state.prunes_rx + prune_rx_inc,
+        prunes=prunes_new,
+        prunes_rx=prunes_rx_new,
+        **repair_extra,
     )
     if deg_in is None:
         return new_state
@@ -332,6 +431,8 @@ def heartbeat_step(
     fired = (need > 0).any() | over.any()
     if params.opportunistic_graft_threshold > -9999.0:
         fired = fired | og.any()
+    if params.evict:
+        fired = fired | evict_fired
     deg_out = jax.lax.cond(
         fired, lambda m: m.sum(axis=-1), lambda m: deg_in, mesh)
     return new_state, deg_out

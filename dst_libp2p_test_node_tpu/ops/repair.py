@@ -1,0 +1,314 @@
+"""Mesh repair: score eviction, PX-on-PRUNE, and re-dial recovery.
+
+GossipSub v1.1's resilience story is not just that badly-scored peers stop
+being *accepted* — the mesh actively heals (arXiv:2007.02754 §2; the ACL2s
+formalization arXiv:2311.08859 treats the PRUNE/PX/backoff machine as the
+correctness-critical core):
+
+  eviction   mesh maintenance PRUNEs members whose score sank below a floor,
+             with backoff on both sides (the opt-in `params.evict` lax.cond
+             branch in ops/heartbeat.py).
+  PX         a PRUNE carries peer-exchange candidates — the pruner's
+             best-scored neighbors — which the prunee may graft or dial
+             (the opt-in `params.px` capture branch in ops/heartbeat.py
+             writes SimState.px_pool; `repair_round` here acts on it).
+  re-dial    a peer starved below D_low for `redial_patience` heartbeats
+             dials its way back in: PX pool first, then the ambient
+             known-peer table (modeled as a uniform random peer — every
+             reference node keeps a peer store / bootstrap list).
+
+The dial controller makes the CONNECTION GRAPH dynamic — the one thing the
+engine's involution substrate (ops/graph.py) treats as an epoch constant.
+The contract that keeps this sound:
+
+  * new edges only ever fill never-used padding slots (conns == -1); the
+    reverse-slot involution is extended functionally in the same round
+    (conns/rev/out_mask travel in the scan carry, never mutated in place);
+  * at most ONE dial per peer per heartbeat, and an acceptor takes at most
+    one inbound dial per round (lowest dialer id wins; a dialing peer does
+    not accept) — collision-free fixed-shape scatters, no retry loops;
+  * any committed dial invalidates the warm-start carry wholesale
+    (SimState.warm_offset_ms := INF — the same invalidation contract as
+    churn: the offsets were measured on the old reachability graph), and
+    the host must re-derive every hoisted per-edge table before the next
+    publish (Simulator.rebind_graph: valid_edge, lat_edge/loss_edge,
+    answer tables all index the mutated conns/rev).
+
+Non-adaptive adversary assumption: `run_recovery_heartbeats` passes
+actor=~attacker, i.e. attackers do NOT run the repair controller to worm
+back into the mesh after being evicted (their per-scenario behavior is the
+whole attack model, ops/adversary.py). Adaptive adversaries that abuse
+PX/re-dial are the documented follow-on (ROADMAP).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from .adversary import attack_observables
+from .heartbeat import heartbeat_step
+from .state import SimParams, SimState
+
+INF = jnp.float32(3.4e38)
+
+
+@dataclass(frozen=True)
+class RepairParams:
+    """The repair knobs as a standalone (hashable) config surface.
+
+    These mirror the SimParams fields one-to-one; `apply` folds them into a
+    SimParams so the campaign/CLI can arm repair on an existing experiment
+    without re-deriving the whole parameter set. Defaults are all OFF —
+    RepairParams().apply(p) == p and the compiled paths stay bit-identical
+    to the repair-free engine."""
+
+    evict: bool = False
+    eviction_threshold: float = -50.0
+    px: bool = False
+    px_count: int = 6
+    redial: bool = False
+    redial_patience: int = 3
+
+    @property
+    def enabled(self) -> bool:
+        return self.evict or self.px or self.redial
+
+    def validate(self) -> None:
+        if self.eviction_threshold > 0:
+            raise ValueError("eviction_threshold must be <= 0")
+        if self.px_count < 1:
+            raise ValueError("px_count must be >= 1")
+        if self.redial_patience < 1:
+            raise ValueError("redial_patience must be >= 1")
+
+    def apply(self, params: SimParams) -> SimParams:
+        out = dataclasses.replace(
+            params,
+            evict=self.evict,
+            eviction_threshold=self.eviction_threshold,
+            px=self.px,
+            px_count=self.px_count,
+            redial=self.redial,
+            redial_patience=self.redial_patience,
+        )
+        out.validate()
+        return out
+
+
+def repair_round(
+    state: SimState,
+    conns: jnp.ndarray,
+    rev: jnp.ndarray,
+    out_mask: jnp.ndarray,
+    params: SimParams,
+    actor: jnp.ndarray | None = None,
+    batch_factor: int = 1,
+):
+    """One round of the repair controller, applied AFTER heartbeat_step.
+
+    Returns (state, conns, rev, out_mask) — the graph arrays are part of the
+    result because committed dials extend the involution. `actor`: (N,) bool
+    mask of peers that RUN the controller (default all); non-actors still
+    accept inbound dials (acceptance is passive — a socket, not a policy).
+
+    Per acting peer and round, at most one action:
+      graft  the first plausible PX candidate that is already connected
+             (subject to both sides' backoff, degree need, and score >= 0 —
+             exactly handleGraft's acceptance), or
+      dial   an unconnected candidate — PX pool first, else (re-dial
+             trigger) a uniform random known peer — filling one free slot
+             on each side and grafting the fresh edge (score 0, no backoff).
+
+    The whole action machinery runs under one lax.cond: a healthy network
+    (nobody starved, no PX pending) pays only the trigger probes."""
+    n, c = conns.shape
+    me = jnp.arange(n, dtype=jnp.int32)
+    iota_c = jnp.arange(c, dtype=jnp.int32)
+    t = state.t_ms
+    alive_sub = state.alive & state.subscribed
+    act = alive_sub if actor is None else (actor & alive_sub)
+
+    deg = state.mesh_mask.sum(axis=-1)
+
+    # -- starvation counter (re-dial trigger) --------------------------------
+    if params.redial:
+        starve = jnp.where(act & (deg < params.d_low), state.starve_hb + 1, 0)
+    else:
+        starve = state.starve_hb
+
+    key, k_dial = jax.random.split(state.key)
+
+    # -- candidate selection (cheap, outside the cond: it IS the trigger) ----
+    pool = state.px_pool
+    pool_c = jnp.clip(pool, 0)
+    cand_ok = (pool >= 0) & (pool != me[:, None]) & alive_sub[pool_c]
+    has_cand = cand_ok.any(axis=-1)
+    k0 = jnp.argmax(cand_ok, axis=-1)
+    cand = jnp.take_along_axis(pool, k0[:, None], axis=1)[:, 0]
+
+    # ambient known-peer table: one uniform draw over [0, n) \ {me}
+    r = jax.random.randint(k_dial, (n,), 0, n - 1, dtype=jnp.int32)
+    r = jnp.where(r >= me, r + 1, r)
+
+    px_want = jnp.zeros((n,), dtype=bool)
+    redial_want = jnp.zeros((n,), dtype=bool)
+    if params.px:
+        px_want = act & (deg < params.d) & has_cand
+    if params.redial:
+        redial_want = act & (starve >= params.redial_patience)
+    use_px = px_want | (redial_want & has_cand)
+    use_rand = redial_want & ~has_cand & alive_sub[r]
+    want = use_px | use_rand
+    tgt = jnp.where(use_px, cand, jnp.where(use_rand, r, -1))
+    tgt_c = jnp.clip(tgt, 0)
+
+    def _fire(_):
+        hit = (conns == tgt_c[:, None]) & want[:, None]
+        connected = hit.any(axis=-1)
+        slot_a = jnp.argmax(hit, axis=-1)
+
+        # ---- path A: candidate already connected -> plain GRAFT ----------
+        sc = state.score(params)
+        take = lambda a: jnp.take_along_axis(a, slot_a[:, None], axis=1)[:, 0]
+        j_a = take(rev)
+        my_ok = ((take(state.backoff_until) <= t)
+                 & (take(sc) >= 0.0) & ~take(state.mesh_mask))
+        graft_a = (want & connected & my_ok
+                   & (state.backoff_until[tgt_c, j_a] <= t)
+                   & (sc[tgt_c, j_a] >= 0.0))
+        mesh = state.mesh_mask | (
+            graft_a[:, None] & (iota_c[None, :] == slot_a[:, None]))
+        mesh = mesh.at[tgt_c, j_a].max(graft_a)
+
+        # ---- path B: unconnected -> dial into a free padding slot --------
+        has_free = (conns < 0).any(axis=-1)
+        free_slot = jnp.argmax(conns < 0, axis=-1).astype(jnp.int32)
+        dial_try = want & ~connected & has_free
+        # target-side screening: free slot, alive, not itself dialing (a
+        # dialer never accepts in the same round — breaks the mutual-dial
+        # double-edge race deterministically)
+        attempt = dial_try & has_free[tgt_c] & alive_sub[tgt_c] & ~dial_try[tgt_c]
+        # one inbound dial per acceptor per round: lowest dialer id wins
+        winner = jnp.full((n,), n, dtype=jnp.int32).at[
+            jnp.where(attempt, tgt_c, 0)].min(jnp.where(attempt, me, n))
+        committed = attempt & (winner[tgt_c] == me)
+        accepted = winner < n
+        dialer = jnp.where(accepted, winner, 0)
+
+        my_hot = committed[:, None] & (iota_c[None, :] == free_slot[:, None])
+        acc_hot = accepted[:, None] & (iota_c[None, :] == free_slot[:, None])
+        j_t = free_slot[tgt_c]       # my rev entry = the target's free slot
+        i_d = free_slot[dialer]      # acceptor's rev entry = dialer's slot
+        new_conns = jnp.where(my_hot, tgt_c[:, None], conns)
+        new_conns = jnp.where(acc_hot, dialer[:, None], new_conns)
+        new_rev = jnp.where(my_hot, j_t[:, None], rev)
+        new_rev = jnp.where(acc_hot, i_d[:, None], new_rev)
+        new_out = out_mask | my_hot  # the dialer side is the outbound one
+
+        # fresh edge: scrub per-edge state (padding slots are zero already —
+        # defense in depth) and graft both sides (score 0, no backoff: this
+        # is exactly the PX-graft the prunee was promised)
+        hot = my_hot | acc_hot
+        mesh = mesh | hot
+        backoff = jnp.where(hot, 0.0, state.backoff_until)
+        fmd = jnp.where(hot, 0.0, state.fmd)
+        slow = jnp.where(hot, 0.0, state.slow_penalty)
+
+        # a committed dial changes the reachability graph the warm-start
+        # offsets were measured on: invalidate the whole carry (the same
+        # contract as churn, ops/heartbeat.py)
+        warm = jnp.where(committed.any(),
+                         jnp.full_like(state.warm_offset_ms, 3.4e38),
+                         state.warm_offset_ms)
+
+        i32 = jnp.int32
+        grafts = state.grafts + (graft_a | committed).astype(i32)
+        grafts_rx = state.grafts_rx.at[
+            jnp.where(graft_a, tgt_c, 0)].add(graft_a.astype(i32))
+        grafts_rx = grafts_rx + accepted.astype(i32)
+        px_grafts = state.px_grafts + (
+            graft_a | (committed & use_px)).astype(i32)
+        redials = state.redials + committed.astype(i32)
+
+        # consume the examined pool entry (success or fail) so a dead
+        # candidate cannot wedge the controller
+        pw = pool.shape[1]
+        pool2 = jnp.where(
+            use_px[:, None] & (jnp.arange(pw)[None, :] == k0[:, None]),
+            -1, pool)
+        return (mesh, backoff, fmd, slow, warm, new_conns, new_rev, new_out,
+                pool2, grafts, grafts_rx, px_grafts, redials)
+
+    def _skip(_):
+        return (state.mesh_mask, state.backoff_until, state.fmd,
+                state.slow_penalty, state.warm_offset_ms, conns, rev,
+                out_mask, pool, state.grafts, state.grafts_rx,
+                state.px_grafts, state.redials)
+
+    (mesh, backoff, fmd, slow, warm, conns2, rev2, out2, pool2,
+     grafts, grafts_rx, px_grafts, redials) = jax.lax.cond(
+        want.any(), _fire, _skip, jnp.int32(0))
+
+    new_state = state.replace(
+        mesh_mask=mesh, backoff_until=backoff, fmd=fmd, slow_penalty=slow,
+        warm_offset_ms=warm, px_pool=pool2, starve_hb=starve, key=key,
+        grafts=grafts, grafts_rx=grafts_rx,
+        px_grafts=px_grafts, redials=redials,
+    )
+    return new_state, conns2, rev2, out2
+
+
+@partial(jax.jit,
+         static_argnames=("params", "steps", "publisher", "batch_factor"))
+def run_recovery_heartbeats(
+    state: SimState,
+    conns: jnp.ndarray,
+    rev: jnp.ndarray,
+    out_mask: jnp.ndarray,
+    attacker: jnp.ndarray,
+    params: SimParams,
+    steps: int,
+    publisher: int = 0,
+    batch_factor: int = 1,
+):
+    """The post-attack recovery window: lax.scan of
+    [heartbeat_step (evict/px branches armed) -> repair_round] x steps with
+    the CONNECTION GRAPH in the carry — committed dials thread forward into
+    every subsequent round's pulls, exactly like state.
+
+    Unlike run_heartbeats/run_attacked_heartbeats, NOTHING hoists out of the
+    scan: conns itself is loop-carried, so the per-step neighbor pull is
+    load-bearing. Returns ((state, conns, rev, out_mask), obs) with obs
+    leaves shaped (steps,) — the attack observables (shared with
+    adversary_round, so campaign curves concatenate) plus per-round repair
+    activity and the publisher's honest mesh degree (the eclipse-recovery
+    signal)."""
+
+    def body(carry, _):
+        s, cn, rv, om = carry
+        ev0 = s.evictions.sum()
+        px0 = s.px_grafts.sum()
+        rd0 = s.redials.sum()
+        s = heartbeat_step(s, cn, rv, om, params, batch_factor=batch_factor)
+        s, cn, rv, om = repair_round(
+            s, cn, rv, om, params, actor=~attacker,
+            batch_factor=batch_factor)
+        obs = attack_observables(s, cn, rv, attacker, params,
+                                 batch_factor=batch_factor)
+        f32 = jnp.float32
+        nbr = cn[publisher]
+        att_n = (nbr >= 0) & attacker[jnp.clip(nbr, 0)]
+        obs["pub_honest_degree"] = (
+            s.mesh_mask[publisher] & (nbr >= 0) & ~att_n).sum().astype(f32)
+        obs["evictions"] = (s.evictions.sum() - ev0).astype(f32)
+        obs["px_grafts"] = (s.px_grafts.sum() - px0).astype(f32)
+        obs["redials"] = (s.redials.sum() - rd0).astype(f32)
+        return (s, cn, rv, om), obs
+
+    return jax.lax.scan(
+        body, (state, conns, rev, out_mask), None, length=steps)

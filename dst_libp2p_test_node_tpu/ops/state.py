@@ -19,6 +19,13 @@ from flax import struct
 
 from ..config.env import GossipSubParams
 
+# Width of the per-peer PX candidate pool (SimState.px_pool). A CONSTANT, not
+# a SimParams field: the pool is a state leaf, and keying its shape on a
+# tunable would make checkpoints / stacked trial pytrees incompatible across
+# repair configs. params.px_count (<= this) bounds how many entries a PRUNE
+# actually fills; the rest stay -1.
+PX_POOL_WIDTH = 8
+
 
 @dataclass(frozen=True)
 class SimParams:
@@ -100,6 +107,16 @@ class SimParams:
     idontwant_threshold_bytes: int = 1000  # go-test-node/main.go:165 (v1.2)
     churn_down_per_hb: float = 0.0  # P(alive peer dies) per heartbeat
     churn_up_per_hb: float = 0.0    # P(dead peer revives) per heartbeat
+    # Mesh-repair subsystem (ops/repair.py + the opt-in heartbeat branches).
+    # All OFF by default: the compiled default step contains none of the
+    # repair ops and is bit-identical to the repair-free engine (pinned by
+    # tests/test_repair.py).
+    evict: bool = False                 # score-based mesh eviction branch
+    eviction_threshold: float = -50.0   # PRUNE mesh members scoring below this
+    px: bool = False                    # peer exchange on PRUNE
+    px_count: int = 6                   # candidate ids per PRUNE (<= PX_POOL_WIDTH)
+    redial: bool = False                # re-dial controller for starved peers
+    redial_patience: int = 3            # heartbeats below d_low before dialing
 
     def validate(self) -> None:
         if not (0 < self.d_low <= self.d <= self.d_high <= self.capacity):
@@ -123,6 +140,15 @@ class SimParams:
                      "graylist_threshold"):
             if getattr(self, name) > 0:
                 raise ValueError(f"{name} must be <= 0")
+        if self.eviction_threshold > 0:
+            # eviction is a score defense: a positive threshold would evict
+            # well-behaved zero-scored peers every heartbeat
+            raise ValueError("eviction_threshold must be <= 0")
+        if not (1 <= self.px_count <= PX_POOL_WIDTH):
+            raise ValueError(
+                f"px_count must be in [1, {PX_POOL_WIDTH}], got {self.px_count}")
+        if self.redial_patience < 1:
+            raise ValueError("redial_patience must be >= 1")
 
     @classmethod
     def from_gossipsub(
@@ -233,6 +259,19 @@ class SimState:
     idontwant_tx: jnp.ndarray  # (N,) int32 IDONTWANTs sent (v1.2: on first
     #                            receipt of a large message, to mesh peers)
     idontwant_rx: jnp.ndarray  # (N,) int32 IDONTWANTs received
+    # mesh-repair bookkeeping (ops/repair.py; inert at the repair-off
+    # default — the default compiled step neither reads nor writes them)
+    px_pool: jnp.ndarray       # (N, PX_POOL_WIDTH) int32 — PX candidate ids
+    #                            carried by the most recent PRUNE received;
+    #                            -1 = empty slot
+    starve_hb: jnp.ndarray     # (N,) int32 — consecutive heartbeats the peer
+    #                            spent below d_low (re-dial trigger)
+    evictions: jnp.ndarray     # (N,) int32 — score-evictions sent (a subset
+    #                            of `prunes`, counted separately)
+    px_grafts: jnp.ndarray     # (N,) int32 — mesh edges gained through a PX
+    #                            candidate (grafted or dialed+grafted)
+    redials: jnp.ndarray       # (N,) int32 — new connections dialed by the
+    #                            re-dial controller
 
     def score(self, params: SimParams) -> jnp.ndarray:
         """Peer score as seen across each directed edge (v1.1 subset:
@@ -278,6 +317,11 @@ def init_state(params: SimParams, seed: int = 0) -> SimState:
         iwant_rx=jnp.zeros((n,), dtype=jnp.int32),
         idontwant_tx=jnp.zeros((n,), dtype=jnp.int32),
         idontwant_rx=jnp.zeros((n,), dtype=jnp.int32),
+        px_pool=jnp.full((n, PX_POOL_WIDTH), -1, dtype=jnp.int32),
+        starve_hb=jnp.zeros((n,), dtype=jnp.int32),
+        evictions=jnp.zeros((n,), dtype=jnp.int32),
+        px_grafts=jnp.zeros((n,), dtype=jnp.int32),
+        redials=jnp.zeros((n,), dtype=jnp.int32),
     )
 
 

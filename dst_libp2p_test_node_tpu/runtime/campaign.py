@@ -26,6 +26,19 @@ Trial anatomy (one trial = one (fraction, seed) cell):
             delivery mask); received-but-undelivered mesh edges accrue the
             P3-analog penalty (censorship_penalty_update) after each
             publish, so censors get scored out across the schedule.
+  recovery  optional (recovery_heartbeats > 0): after the attack window —
+            and after the trial checkpoint, which hashes the EPOCH graph —
+            the mesh-repair subsystem runs `recovery_heartbeats` rounds of
+            [heartbeat_step (evict/px armed via cfg.repair) -> repair_round]
+            (ops/repair.run_recovery_heartbeats). The dial controller can
+            MUTATE the connection graph, so the simulator rebinds every
+            hoisted per-edge table afterwards (Simulator.rebind_graph) and
+            the publish schedule measures delivery over the HEALED graph;
+            the epoch graph is restored before the next trial. Attackers
+            do not run the controller (non-adaptive adversary — see
+            ops/repair.py); the attack window itself stays on the standard
+            params, so attack-window traces are bit-identical whether or
+            not a recovery window follows.
 
 Zero-attacker contract: a fraction-0.0 trial takes EXACTLY the benign
 Simulator path — no adversary call, no censor mask (None keeps the publish
@@ -40,9 +53,15 @@ Resilience metrics per trial:
                        honest->attacker edges score below graylist_threshold
                        (compare against the closed-form budget
                        ops/adversary.heartbeats_to_graylist)
-  mesh_recovery_hb     first window round after peak where the attacker
-                       share of honest mesh edges falls back under
-                       `mesh_recovery_share`
+  mesh_recovery_hb     first round after peak where the attacker share of
+                       honest mesh edges falls back under
+                       `mesh_recovery_share` (attack + recovery windows
+                       concatenated — the shared attack_observables make
+                       the curves continuous)
+  recovery_time_ms     first recovery-window round where the attacker mesh
+                       share is back under the floor AND the publisher has
+                       at least one honest mesh edge, in sim ms; -1 = not
+                       recovered (only meaningful with recovery_heartbeats)
 
 Warm-start/checkpoint reuse: the experiment's `warm_start` flag threads
 through unchanged (the publish schedule warm-starts its fixpoints), and
@@ -69,6 +88,7 @@ from ..ops.adversary import (
     heartbeats_to_graylist,
     run_attacked_heartbeats,
 )
+from ..ops.repair import RepairParams, run_recovery_heartbeats
 from .simulator import ExperimentConfig, MessageRecord, Simulator
 from .summarize import sanitize_nonfinite
 
@@ -108,6 +128,12 @@ class CampaignConfig:
     attack_heartbeats: int = 20
     # attacker mesh-share floor that counts as "recovered"
     mesh_recovery_share: float = 0.05
+    # post-attack repair rounds (0 = no recovery window; the pre-repair
+    # campaign shape, bit-identical trial outputs)
+    recovery_heartbeats: int = 0
+    # mesh-repair knobs for the recovery window (ops/repair.py); defaults
+    # are all OFF, i.e. a recovery window that only runs benign heartbeats
+    repair: RepairParams = field(default_factory=RepairParams)
     # batch same-fraction trials into one vmapped attack window (un-sharded
     # runs only; sharded runs go sequential so placement stays row-wise)
     vmap_trials: bool = True
@@ -131,6 +157,9 @@ class CampaignConfig:
                 raise ValueError(f"attacker fraction {f} outside [0, 1)")
         if self.attack_heartbeats < 1:
             raise ValueError("attack_heartbeats must be >= 1")
+        if self.recovery_heartbeats < 0:
+            raise ValueError("recovery_heartbeats must be >= 0")
+        self.repair.validate()
         if adv.eclipse:
             if self.experiment.gossipsub.flood_publish:
                 # flood_publish sends to EVERY connected peer regardless of
@@ -164,6 +193,12 @@ class TrialResult:
     attacker_mesh_share_final: float
     attacker_score_final: float
     wall_s: float
+    # mesh-repair subsystem outputs (defaults keep pre-repair trial dicts
+    # valid: zero activity, no recovery window)
+    mesh_evictions_total: int = 0
+    px_grafts_total: int = 0
+    redials_total: int = 0
+    recovery_time_ms: float = -1.0
 
     def to_dict(self) -> dict:
         # strict-JSON consumers run allow_nan=False; the shared sanitizer
@@ -372,6 +407,9 @@ def _attacked_trials(
     states, obs_list = _attack_windows(
         sim, [aj for _, aj in cohorts], states, adv, steps)
 
+    # the dial controller can mutate the graph arrays per trial; keep the
+    # epoch graph to restore before the next trial's reset
+    epoch_arrays = dict(sim.arrays)
     out = []
     for j, s in enumerate(seeds):
         att, att_j = cohorts[j]
@@ -385,17 +423,45 @@ def _attacked_trials(
             save_checkpoint(sim, os.path.join(
                 cfg.checkpoint_dir,
                 f"{cfg.scenario}_f{fraction:g}_s{s}.npz"))
+        obs_j = obs_list[j]
+        recovery_time_ms = -1.0
+        if cfg.recovery_heartbeats > 0:
+            # post-attack repair window. The checkpoint above snapshots the
+            # post-window/pre-repair state against the EPOCH graph (whose
+            # hash is the checkpoint identity) — recovery must come after.
+            import jax
+
+            rparams = cfg.repair.apply(sim.params)
+            a = sim.arrays
+            (st2, cn2, rv2, om2), robs = run_recovery_heartbeats(
+                sim.state, a["conns"], a["rev"], a["out_mask"], att_j,
+                rparams, cfg.recovery_heartbeats, publisher=pub)
+            robs = jax.tree_util.tree_map(np.asarray, robs)
+            sim.state = st2
+            sim.rebind_graph(cn2, rv2, om2)
+            # concatenate the shared observables: engagement/recovery
+            # rounds are counted over the whole attack+recovery timeline
+            obs_j = {k: np.concatenate(
+                [np.asarray(obs_j[k]), np.asarray(robs[k])]) for k in obs_j}
+            rec_ok = ((robs["attacker_mesh_share"]
+                       <= cfg.mesh_recovery_share)
+                      & (robs["pub_honest_degree"] >= 1.0))
+            hit = np.nonzero(rec_ok)[0]
+            if hit.size:
+                recovery_time_ms = float((hit[0] + 1) * hb_ms)
         censor = censor_mask(att_j, sim.arrays["conns"])
         records = _publish_schedule(sim, censor=censor, attacker=att_j,
                                     adv=adv)
         honest = ~att
         cov, p50, p99 = _delivery_metrics(records, honest)
         engaged, gf_final, recovery, share_final = _obs_metrics(
-            obs_list[j], cfg.mesh_recovery_share)
+            obs_j, cfg.mesh_recovery_share)
         # final honest-side view of attacker edges (post-publish: includes
-        # the censorship penalties the window could not see)
+        # the censorship penalties the window could not see). Read the
+        # CURRENT conns — the repair window may have extended the graph.
+        cn_now = np.asarray(sim.arrays["conns"])
         sc = np.asarray(sim.state.score(sim.params), dtype=np.float64)
-        att_edge = (conns_np >= 0) & att[np.clip(conns_np, 0, None)]
+        att_edge = (cn_now >= 0) & att[np.clip(cn_now, 0, None)]
         h_att = att_edge & honest[:, None]
         score_final = float(sc[h_att].mean()) if h_att.any() else 0.0
         out.append(TrialResult(
@@ -412,7 +478,16 @@ def _attacked_trials(
             attacker_mesh_share_final=share_final,
             attacker_score_final=score_final,
             wall_s=(time.time() - t0) / len(seeds),
+            mesh_evictions_total=int(np.asarray(sim.state.evictions).sum()),
+            px_grafts_total=int(np.asarray(sim.state.px_grafts).sum()),
+            redials_total=int(np.asarray(sim.state.redials).sum()),
+            recovery_time_ms=recovery_time_ms,
         ))
+        if cfg.recovery_heartbeats > 0:
+            # restore the epoch graph: the next trial (and _reset_trial's
+            # valid_edge refresh) must start from the built topology
+            sim.rebind_graph(epoch_arrays["conns"], epoch_arrays["rev"],
+                             epoch_arrays["out_mask"])
     return out
 
 
@@ -426,7 +501,7 @@ def run_campaign(cfg: CampaignConfig, mesh=None) -> CampaignResult:
     t0 = time.time()
     sim = Simulator(cfg.experiment, mesh=mesh)
     budget = heartbeats_to_graylist(adv, sim.params)
-    if (adv.graft_flood or adv.ihave_spam) and any(
+    if (adv.graft_flood or adv.ihave_spam or adv.iwant_spam) and any(
             f > 0 for f in cfg.fractions) and math.isinf(budget):
         raise ValueError(
             "score defense cannot engage under this config "

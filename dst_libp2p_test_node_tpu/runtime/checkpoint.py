@@ -36,11 +36,13 @@ from ..config.env import GossipSubParams
 from ..config.topology import Topology, TopoParams
 from .simulator import ExperimentConfig, MessageRecord, Simulator
 
-FORMAT_VERSION = 7  # bump on any SimState layout change (v7: warm_offset_ms
-#                     cross-publish warm-start carry — older snapshots load
-#                     with the carry defaulted to INF = "no usable carry",
-#                     which is exactly the state a fresh run starts in; v6
-#                     added per-record answer_wait_max_ms, read tolerantly)
+FORMAT_VERSION = 8  # bump on any SimState layout change (v8: mesh-repair
+#                     leaves px_pool/starve_hb/evictions/px_grafts/redials —
+#                     older snapshots load with an empty PX pool and zeroed
+#                     repair counters, exactly a fresh run's repair state;
+#                     v7: warm_offset_ms cross-publish warm-start carry,
+#                     defaulted to INF = "no usable carry"; v6 added
+#                     per-record answer_wait_max_ms, read tolerantly)
 
 
 def _graph_hash(graph) -> str:
@@ -145,10 +147,11 @@ def load_checkpoint(path: str, mesh=None) -> Simulator:
 
     z = np.load(path)
     meta = json.loads(bytes(z["meta_json"]).decode())
-    if meta["version"] not in (5, 6, FORMAT_VERSION):
-        # v5/v6 differ only by absent per-record answer_wait (defaulted by
-        # the record reader) and the absent warm-start carry (defaulted to
-        # INF below) — accept all three
+    if meta["version"] not in (5, 6, 7, FORMAT_VERSION):
+        # v5..v7 differ only by absent leaves with safe fresh-run defaults:
+        # per-record answer_wait (record reader), the warm-start carry
+        # (INF below), and the mesh-repair leaves (empty pool / zero
+        # counters below) — accept all four
         raise ValueError(
             f"checkpoint format {meta['version']} != supported {FORMAT_VERSION}"
         )
@@ -179,6 +182,16 @@ def load_checkpoint(path: str, mesh=None) -> Simulator:
         # a fresh run's first message.
         state_dict["warm_offset_ms"] = np.full(
             (cfg.topo.network_size,), 3.4e38, dtype=np.float32)
+    n = cfg.topo.network_size
+    if "px_pool" not in state_dict:
+        # pre-v8 snapshot: no mesh-repair subsystem. Empty PX pool + zero
+        # starvation/activity counters = a fresh run's repair state.
+        from ..ops.state import PX_POOL_WIDTH
+
+        state_dict["px_pool"] = np.full((n, PX_POOL_WIDTH), -1,
+                                        dtype=np.int32)
+        for k in ("starve_hb", "evictions", "px_grafts", "redials"):
+            state_dict[k] = np.zeros((n,), dtype=np.int32)
     sim.state = serialization.from_state_dict(sim.state, state_dict)
     # the publish-path fanout decision reads a host mirror of subscription
     sim._subscribed_np = np.asarray(sim.state.subscribed).copy()

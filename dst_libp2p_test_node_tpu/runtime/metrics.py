@@ -446,6 +446,22 @@ class CampaignMetrics:
             "dst_testnode_attack_attacker_mesh_share",
             "attacker share of honest mesh edges after the attack window",
             lab)
+        # mesh-repair subsystem (ops/repair.py; populated when the campaign
+        # ran a recovery window — all-zero/-1 otherwise)
+        self.evictions = r.gauge(
+            "dst_testnode_attack_mesh_evictions_total",
+            "score-eviction PRUNEs issued across the trial", lab)
+        self.px_grafts = r.gauge(
+            "dst_testnode_attack_px_grafts_total",
+            "mesh edges gained through PX candidates across the trial", lab)
+        self.redials = r.gauge(
+            "dst_testnode_attack_redials_total",
+            "new connections dialed by the repair controller", lab)
+        self.recovery_time = r.gauge(
+            "dst_testnode_attack_recovery_time_ms",
+            "sim ms from attack-window end until the publisher regained an "
+            "honest mesh edge and attacker mesh share fell under the floor "
+            "(-1 = not recovered)", lab)
 
     def fill_from_campaign(self, campaign: dict) -> None:
         """Project a CampaignResult.to_dict onto the series (duck-typed on
@@ -464,6 +480,10 @@ class CampaignMetrics:
                 (self.mesh_recovery, "mesh_recovery_hb"),
                 (self.attacker_score, "attacker_score_final"),
                 (self.mesh_share, "attacker_mesh_share_final"),
+                (self.evictions, "mesh_evictions_total"),
+                (self.px_grafts, "px_grafts_total"),
+                (self.redials, "redials_total"),
+                (self.recovery_time, "recovery_time_ms"),
             ):
                 v = t.get(key)
                 if v is not None and math.isfinite(float(v)):

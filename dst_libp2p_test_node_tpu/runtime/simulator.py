@@ -374,6 +374,55 @@ class Simulator:
         if not self._churny:
             self._valid_edge = self._compute_valid_edge()
 
+    def rebind_graph(self, conns, rev, out_mask) -> None:
+        """Adopt a mutated connection graph (the repair controller's dial
+        path, ops/repair.py) as the simulator's current one.
+
+        The dial path extends the involution into previously-free padding
+        slots, which staleness-invalidates EVERY hoisted per-edge table:
+        lat_edge/loss_edge and the answer-queue service tables index
+        conns/rev directly, and valid_edge is a function of the edge set.
+        All are re-derived here; the warm-start carry is invalidated
+        wholesale (repair_round already wrote INF on the round a dial
+        committed — this re-asserts it for callers that rebind from a
+        checkpointed state). `self.graph` (the host-side ConnGraph) keeps
+        the EPOCH graph: checkpoint identity hashes the built topology, so
+        save_checkpoint must run before rebind_graph (runtime/campaign.py
+        orders it that way)."""
+        import jax.numpy as jnp
+
+        from ..ops.disseminate import answer_tables, edge_tables
+
+        self.arrays = {
+            "conns": jnp.asarray(conns),
+            "rev": jnp.asarray(rev),
+            "out_mask": jnp.asarray(out_mask),
+        }
+        self._lat_edge, self._loss_edge = edge_tables(
+            self._stage, self._lat, self.arrays["conns"], self.arrays["rev"],
+            self._loss)
+        self._ans_tables = (
+            answer_tables(self._lat_edge, self.arrays["conns"])
+            if self.cfg.with_gossip else None)
+        warm = jnp.full((self.params.n,), 3.4e38, dtype=jnp.float32)
+        if self.mesh is not None:
+            import jax
+
+            from ..parallel.sharding import reshard_rows
+
+            self.arrays = {k: reshard_rows(v, self.mesh)
+                           for k, v in self.arrays.items()}
+            self._lat_edge = reshard_rows(self._lat_edge, self.mesh)
+            if self._loss_edge is not None:
+                self._loss_edge = reshard_rows(self._loss_edge, self.mesh)
+            if self._ans_tables is not None:
+                self._ans_tables = jax.tree_util.tree_map(
+                    lambda x: reshard_rows(x, self.mesh), self._ans_tables)
+            warm = reshard_rows(warm, self.mesh)
+        self.state = self.state.replace(warm_offset_ms=warm)
+        if not self._churny:
+            self._valid_edge = self._compute_valid_edge()
+
     def advance(self, ms: float) -> None:
         """Advance simulated time by `ms`, running the heartbeats due."""
         steps, self._hb_carry_ms = drain_heartbeat_carry(

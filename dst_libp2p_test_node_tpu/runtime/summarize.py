@@ -197,7 +197,8 @@ def report_campaign(campaign: dict) -> str:
            f"{campaign['network_size']}  Graylist budget (hb) :  "
            f"{_cell(campaign.get('hb_budget'))}")
     cols = ("frac \t seed \t attackers \t coverage \t p50_ms \t inflation "
-            "\t hb_gray \t recover_hb \t att_score")
+            "\t hb_gray \t recover_hb \t att_score \t evic \t px \t redial "
+            "\t recover_ms")
     out = [hdr, cols]
     for t in campaign["trials"]:
         out.append(" \t ".join([
@@ -207,6 +208,12 @@ def report_campaign(campaign: dict) -> str:
             _cell(t["latency_inflation"], ".3f"),
             str(t["hb_to_graylist"]), str(t["mesh_recovery_hb"]),
             _cell(t["attacker_score_final"], ".1f"),
+            # repair columns default for pre-repair artifacts (duck-typed:
+            # an old JSON report still renders)
+            str(t.get("mesh_evictions_total", 0)),
+            str(t.get("px_grafts_total", 0)),
+            str(t.get("redials_total", 0)),
+            _cell(t.get("recovery_time_ms", -1.0), ".1f"),
         ]))
     out.append(
         f"Trials :  {len(campaign['trials'])}  trials/s :  "

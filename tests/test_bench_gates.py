@@ -128,3 +128,17 @@ def test_bounded_ladder_wait_bar_stays_finite():
         if r.get("delivery_mode") == "bounded":
             assert math.isfinite(r["answer_wait_max_ms"])
             assert r["answer_wait_max_ms"] >= 0.0
+
+
+def test_bench_guards_repair_probe():
+    # the repair probe (ISSUE 4) must refuse to emit an artifact where the
+    # recovery window did nothing: zero evictions or a GROWING attacker
+    # mesh share means the repair jit silently compiled the disabled path.
+    # Same ordering contract as the exact-mode gates: asserts precede emit.
+    src = open(os.path.join(REPO, "bench.py")).read()
+    assert "assert evictions_total > 0" in src
+    assert "assert att_share_repair <= att_share_attack" in src
+    assert '"repair_trials_per_s"' in src
+    emit = src.index("json.dumps(out")
+    assert src.index("assert evictions_total > 0") < emit
+    assert src.index("assert att_share_repair <= att_share_attack") < emit
