@@ -1,6 +1,24 @@
 """Benchmark: simulated peers x heartbeat-rounds per second (metric of record).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline",
+"vs_best_committed"}. The two ratios mean different things:
+
+  vs_baseline        value / the reference harness's effective throughput
+                     (BASELINE_PEER_ROUNDS_PER_SEC, a fixed constant — see
+                     the baseline note below). "How much faster than Shadow."
+  vs_best_committed  value / the best metric-of-record value across the
+                     committed repo-root BENCH_r*.json artifacts. "How does
+                     this run compare to the best this repo has shipped."
+
+Regression tripwire: when vs_best_committed falls below
+1 - REGRESSION_TOLERANCE (i.e. a >20% regression against the best committed
+artifact — the r05 failure mode, where dead repair state in the default scan
+carries silently cost 2.2x), the artifact gains a strict-JSON "error" field
+and the process exits nonzero, so the driver records the regression instead
+of committing it as the new normal. The wire only arms on accelerator
+backends (the committed artifacts are device runs; a CPU smoke is orders of
+magnitude off for reasons that are not regressions); BENCH_TRIPWIRE=1 forces
+it on, BENCH_TRIPWIRE=0 forces it off.
 
 Baseline note (BASELINE.md): the reference publishes no numbers. The
 comparison constant below is the reference harness's *effective* simulation
@@ -29,6 +47,43 @@ BASELINE_PEER_ROUNDS_PER_SEC = 1000.0
 N_PEERS = 100_000
 HB_ROUNDS = 300          # timed heartbeat rounds
 MESSAGES = 3             # timed dissemination fixpoints (one per ~100 rounds)
+
+# fraction of the best committed value a run may fall short by before the
+# tripwire fires (module docstring "Regression tripwire")
+REGRESSION_TOLERANCE = 0.20
+
+
+def best_committed_peer_rounds(repo_root: str | None = None) -> float | None:
+    """Best metric-of-record value across the committed BENCH_r*.json
+    artifacts, or None when none parse. Each artifact is the driver's wrapper
+    {"n", "cmd", "rc", "tail"} — the bench's own JSON line lives INSIDE the
+    "tail" string (after any warnings), so this scans tail lines for the
+    {"metric": "simulated_peer_rounds_per_sec", ...} record."""
+    import glob
+    import os
+
+    root = repo_root or os.path.dirname(os.path.abspath(__file__))
+    best = None
+    for path in sorted(glob.glob(os.path.join(root, "BENCH_r*.json"))):
+        try:
+            with open(path) as fh:
+                art = json.load(fh)
+        except (OSError, json.JSONDecodeError):
+            continue
+        for line in str(art.get("tail", "")).splitlines():
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if rec.get("metric") != "simulated_peer_rounds_per_sec":
+                continue
+            v = rec.get("value")
+            if isinstance(v, (int, float)) and (best is None or v > best):
+                best = float(v)
+    return best
 
 
 def main() -> None:
@@ -347,11 +402,24 @@ def main() -> None:
     delays = np.stack([np.asarray(r.delay_ms) for r in results])
     ok = delays < 1e30
     coverage = float(ok.mean())
+    # regression tripwire vs the best committed artifact (module docstring)
+    best = best_committed_peer_rounds()
+    import os as _os
+
+    trip_env = _os.environ.get("BENCH_TRIPWIRE", "")
+    trip_armed = (trip_env == "1"
+                  or (trip_env != "0" and jax.default_backend() != "cpu"))
+    regressed = (best is not None
+                 and value < (1.0 - REGRESSION_TOLERANCE) * best)
     out = {
         "metric": "simulated_peer_rounds_per_sec",
         "value": round(value, 1),
         "unit": "peers*rounds/s",
+        # value / the fixed reference-harness constant ("vs Shadow")
         "vs_baseline": round(value / BASELINE_PEER_ROUNDS_PER_SEC, 2),
+        # value / the best committed BENCH_r*.json ("vs our own best")
+        "vs_best_committed": (round(value / best, 3)
+                              if best is not None else None),
         "detail": {
             "n_peers": N_PEERS,
             "rounds": rounds,
@@ -438,8 +506,18 @@ def main() -> None:
     # literal Infinity and downstream parsers choke)
     from dst_libp2p_test_node_tpu.runtime.summarize import sanitize_nonfinite
 
+    if regressed and trip_armed:
+        out["error"] = (
+            f"bench regression: {value:.1f} peer-rounds/s is more than "
+            f"{REGRESSION_TOLERANCE:.0%} below the best committed "
+            f"{best:.1f} (BENCH_r*.json)")
     out = sanitize_nonfinite(out)
     print(json.dumps(out, allow_nan=False))
+    if regressed and trip_armed:
+        # nonzero exit AFTER the strict-JSON artifact: the driver still
+        # captures the full detail block, but records the run as failed
+        # instead of committing the regression as the new normal
+        raise SystemExit(1)
 
 
 if __name__ == "__main__":

@@ -7,6 +7,10 @@
   5. 1M peers, mix-routed (MOUNTSMIX/MIXD=4)  [--all only; ~minutes]
   6. 2k peers, adversarial campaign (sybil graft-flood sweep)
      [--attack / --only 6; never written to BENCH_CONFIGS.json]
+  7. 2k peers, SHARDED adversarial campaign: the fraction x seed grid
+     partitioned over trial groups (parallel/sharding.make_trial_mesh);
+     single-device hosts fall back to the vmapped stack  [--all only;
+     COMMITTED — the ROADMAP "attack ladder entry"]
 
 Each config prints ONE JSON line: config id, peers, wall seconds,
 peers*rounds/sec, coverage, p50/p99 dissemination latency (ms). Run:
@@ -260,8 +264,68 @@ def config_6():
     return out
 
 
+def config_7():
+    """Committed sharded adversarial sweep (the ROADMAP "1M-peer attack
+    ladder" line's first rung): sybil graft-flood, fractions {0, 0.1} x
+    seeds {0..3}, with the TRIAL axis sharded over the visible devices
+    (runtime/campaign.run_campaign(trial_mesh=...) — each device group runs
+    its slice of the seed column concurrently). Single-device hosts fall
+    back to the vmapped stack: identical numbers (tests/test_trial_sharding
+    pins sharded == vmapped), different wall. Unlike config 6 this row IS
+    part of the committed BENCH_CONFIGS.json ladder; the resilience gates
+    match config 6 and the tracked series is attack_trials_per_s over the
+    two-level-parallel path."""
+    import jax
+
+    from dst_libp2p_test_node_tpu.parallel.sharding import make_trial_mesh
+    from dst_libp2p_test_node_tpu.runtime.campaign import (
+        CampaignConfig, attack_gossipsub, run_campaign)
+    from dst_libp2p_test_node_tpu.runtime.simulator import ExperimentConfig
+
+    n = 2048
+    groups = min(len(jax.devices()), 4)
+    trial_mesh = (make_trial_mesh(groups, n_devices=groups)
+                  if groups > 1 else None)
+    cfg = CampaignConfig(
+        scenario="sybil_graft_flood",
+        fractions=(0.0, 0.1),
+        seeds=(0, 1, 2, 3),
+        experiment=ExperimentConfig(
+            topo=_topo(n, 2000), connect_to=10,
+            gossipsub=attack_gossipsub(), warmup_s=30.0, seed=0),
+        attack_heartbeats=20,
+    )
+    res = run_campaign(cfg, trial_mesh=trial_mesh)
+    attacked = [t for t in res.trials if t.fraction > 0]
+    cov = min(t.honest_coverage for t in attacked)
+    p50 = max(t.latency_p50_ms for t in attacked)
+    p99 = max(t.latency_p99_ms for t in attacked)
+    engaged = max(t.hb_to_graylist for t in attacked)
+    hb_ms = cfg.experiment.gossipsub.heartbeat_ms
+    per_trial = (cfg.experiment.warmup_s * 1000.0 // hb_ms
+                 + (cfg.experiment.topo.messages - 1)
+                 * cfg.experiment.topo.delay_seconds * 1000.0 // hb_ms)
+    rounds = per_trial * len(res.trials) + cfg.attack_heartbeats * len(attacked)
+    out = {
+        "config": 7,
+        "peers": n,
+        "wall_s": round(res.wall_s, 2),
+        "peer_rounds_per_sec": round(n * rounds / max(res.wall_s, 1e-9), 1),
+        "coverage": round(cov, 4),
+        "p50_ms": round(p50, 1),
+        "p99_ms": round(p99, 1),
+        "scenario": res.scenario,
+        "trial_groups": groups,
+        "attack_trials_per_s": round(res.trials_per_s, 4),
+        "hb_to_graylist": engaged if math.isfinite(engaged) else None,
+        "hb_budget": res.hb_budget,
+    }
+    print(json.dumps(out, allow_nan=False), flush=True)
+    return out
+
+
 CONFIGS = {1: config_1, 2: config_2, 3: config_3, 4: config_4, 5: config_5,
-           6: config_6}
+           6: config_6, 7: config_7}
 
 ARTIFACT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "BENCH_CONFIGS.json")
@@ -309,6 +373,14 @@ def check_results(results: list[dict], artifact_path: str = ARTIFACT) -> list[st
             if not (want - 0.04 <= cov <= want + 0.02):
                 fail(c, f"coverage {cov} outside derived churn expectation "
                         f"{want:.4f} (+0.02/-0.04)")
+        elif c == 7:
+            # worst-case HONEST coverage under the sybil sweep: censors
+            # cannot stop delivery (attackers forward nothing but honest
+            # mesh redundancy routes around them), but the floor is looser
+            # than the churn-free 0.999 — cohort placement can strand a
+            # low-degree honest straggler behind an all-attacker cut
+            if cov < 0.99:
+                fail(c, f"honest coverage {cov} < 0.99 under the sweep")
         elif cov < 0.999:
             fail(c, f"coverage {cov} < 0.999 on a churn-free config")
         # latency sanity bands: delays must sit between one link latency
@@ -317,9 +389,9 @@ def check_results(results: list[dict], artifact_path: str = ARTIFACT) -> list[st
             fail(c, f"p50 {p50} outside [40, p99={p99}]")
         if p99 > 20_000.0:
             fail(c, f"p99 {p99} ms beyond any sane dissemination horizon")
-        # attack config: the tracked throughput series must be live and
+        # attack configs: the tracked throughput series must be live and
         # the defense must engage within the closed-form heartbeat budget
-        if c == 6:
+        if c in (6, 7):
             if not r.get("attack_trials_per_s", 0.0) > 0.0:
                 fail(c, "attack_trials_per_s not positive")
             if r.get("hb_to_graylist") is None:
@@ -337,7 +409,8 @@ def check_results(results: list[dict], artifact_path: str = ARTIFACT) -> list[st
 
 def main():
     p = argparse.ArgumentParser()
-    p.add_argument("--all", action="store_true", help="include the 1M config")
+    p.add_argument("--all", action="store_true",
+                   help="include the 1M (5) and sharded-attack (7) configs")
     p.add_argument("--attack", action="store_true",
                    help="append the adversarial-campaign config (6); never "
                         "part of the committed BENCH_CONFIGS.json ladder")
@@ -347,7 +420,8 @@ def main():
     p.add_argument("--write", metavar="PATH", default=None,
                    help="write the results as the new artifact (JSON lines)")
     a = p.parse_args()
-    runs = [a.only] if a.only else ([1, 2, 3, 4, 5] if a.all else [1, 2, 3, 4])
+    runs = [a.only] if a.only else (
+        [1, 2, 3, 4, 5, 7] if a.all else [1, 2, 3, 4])
     if a.attack and not a.only:
         runs.append(6)
     results = [CONFIGS[c]() for c in runs]

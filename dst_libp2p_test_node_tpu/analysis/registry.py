@@ -29,6 +29,15 @@ The registered surface mirrors the BENCH hot paths exactly:
                           mutated graph
   kad/find_node           the DHT lookup scan
   multitopic/disseminate  the T*N block-diagonal publish
+  campaign/attack_window_sharded
+                          the trial-axis shard_map wrapper around the
+                          vmapped attack window (runtime/campaign.py):
+                          traced on a device-count-adaptive 2-group trial
+                          mesh with the repair leaves STRIPPED, exactly the
+                          program the sharded sweep dispatches (cond census
+                          intentionally unset — the vmapped body trades the
+                          heartbeat conds for select_n, see
+                          run_attacked_heartbeats' note)
 """
 
 from __future__ import annotations
@@ -117,6 +126,36 @@ def _attack_spec() -> TraceSpec:
         fn=run_attacked_heartbeats,
         args=(state, a["conns"], a["rev"], a["out_mask"], att),
         kwargs=dict(params=params, adv=AdversaryParams(), steps=4))
+
+
+def _sharded_attack_spec() -> TraceSpec:
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.adversary import AdversaryParams, attacker_cohort
+    from ..ops.state import strip_repair
+    from ..parallel.sharding import make_trial_mesh
+    from ..runtime.campaign import sharded_attack_window
+
+    g, params, state, a, _ = _single_topic()
+    # production path: params are repair-inert, so the campaign strips the
+    # repair leaves host-side before stacking — trace the same program
+    state, _saved = strip_repair(state)
+    groups = 2 if len(jax.devices()) >= 2 else 1
+    mesh = make_trial_mesh(groups, n_devices=groups)
+    local = 2
+    trials = groups * local
+    stacked = jax.tree_util.tree_map(
+        lambda x: jnp.stack([jnp.asarray(x)] * trials), state)
+    att = jnp.stack([
+        jnp.asarray(attacker_cohort(params.n, 0.25, seed=s))
+        for s in range(trials)])
+    shared = {k: a[k] for k in ("conns", "rev", "out_mask")}
+    return TraceSpec(
+        fn=sharded_attack_window,
+        args=(stacked, shared, att),
+        kwargs=dict(params=params, adv=AdversaryParams(), steps=3,
+                    trial_mesh=mesh, local_trials=local))
 
 
 def _kad_spec() -> TraceSpec:
@@ -351,6 +390,16 @@ def default_contracts() -> list[EntrypointContract]:
             notes="recovery scan: 6 armed-heartbeat conds + the repair "
                   "controller's single action cond, all inside the scan "
                   "body; the graph arrays ride the carry"),
+        EntrypointContract(
+            name="campaign/attack_window_sharded",
+            build=_sharded_attack_spec,
+            expected_conds=None,
+            feedback=[(_first_out, _state_arg_of)],
+            notes="trial-axis shard_map over the vmapped window, repair "
+                  "leaves stripped (the sharded sweep's exact program); "
+                  "the stacked state must feed back aval-stable across "
+                  "windows, and loop/carry rules catch dead weight the "
+                  "r05 way"),
         EntrypointContract(
             name="kad/find_node",
             build=_kad_spec,

@@ -348,6 +348,11 @@ def cmd_attack(argv: list[str]) -> int:
     p.add_argument("--mesh", action="store_true",
                    help="shard the peer axis over all visible devices "
                    "(peers must divide evenly by the device count)")
+    p.add_argument("--trial-groups", type=int, default=None, metavar="N",
+                   help="shard the Monte-Carlo TRIAL axis over N device "
+                   "groups (parallel/sharding.make_trial_mesh; N must "
+                   "divide the device count). Mutually exclusive with "
+                   "--mesh; 0 = one group per visible device")
     p.add_argument("--checkpoint-dir", default=None,
                    help="snapshot each trial's post-window state here")
     # mesh-repair subsystem (ops/repair.py): the recovery window + knobs
@@ -422,8 +427,19 @@ def cmd_attack(argv: list[str]) -> int:
         if a.peers % len(mesh.devices.flat) != 0:
             p.error(f"--mesh needs peers ({a.peers}) divisible by the "
                     f"device count ({len(mesh.devices.flat)})")
+    trial_mesh = None
+    if a.trial_groups is not None:
+        if a.mesh:
+            p.error("--trial-groups and --mesh are mutually exclusive "
+                    "(the trial grid already owns every device)")
+        from .parallel.sharding import make_trial_mesh
+
+        try:
+            trial_mesh = make_trial_mesh(a.trial_groups or None)
+        except ValueError as e:
+            p.error(str(e))
     t0 = time.time()
-    res = run_campaign(cfg, mesh=mesh)
+    res = run_campaign(cfg, mesh=mesh, trial_mesh=trial_mesh)
     wall = time.time() - t0
     d = res.to_dict()
     print(report_campaign(d), end="")

@@ -68,7 +68,8 @@ import numpy as np
 
 from .heartbeat import heartbeat_step
 from .pull import neighbor_pull_bool, reciprocal_pull_bool
-from .state import SimParams, SimState
+from .state import (SimParams, SimState, repair_inert, restore_repair,
+                    strip_repair)
 
 SCENARIOS = (
     "sybil_graft_flood",
@@ -388,7 +389,6 @@ def attack_observables(
     }
 
 
-@partial(jax.jit, static_argnames=("params", "adv", "steps", "batch_factor"))
 def run_attacked_heartbeats(
     state: SimState,
     conns: jnp.ndarray,
@@ -407,7 +407,35 @@ def run_attacked_heartbeats(
     counter and the mesh mid-scan, so per-round decay interleaving and the
     per-step mesh&valid AND are both load-bearing. The alive/subscribed
     neighbor pull still hoists when churn is off (the attack mutates
-    neither). Returns (state, obs) with obs leaves shaped (steps,)."""
+    neither). Returns (state, obs) with obs leaves shaped (steps,).
+
+    Like run_heartbeats, the jit boundary is the inner function: no attack
+    behavior touches the mesh-repair leaves, so attack windows with repair
+    off (the common campaign case — repair arms only the RECOVERY window)
+    run with the 5 repair leaves stripped from the scan carry."""
+    if repair_inert(params):
+        state, saved = strip_repair(state)
+        out, obs = _run_attacked_heartbeats(
+            state, conns, rev, out_mask, attacker, params, adv, steps,
+            batch_factor)
+        return restore_repair(out, saved), obs
+    return _run_attacked_heartbeats(
+        state, conns, rev, out_mask, attacker, params, adv, steps,
+        batch_factor)
+
+
+@partial(jax.jit, static_argnames=("params", "adv", "steps", "batch_factor"))
+def _run_attacked_heartbeats(
+    state: SimState,
+    conns: jnp.ndarray,
+    rev: jnp.ndarray,
+    out_mask: jnp.ndarray,
+    attacker: jnp.ndarray,
+    params: SimParams,
+    adv: AdversaryParams,
+    steps: int,
+    batch_factor: int = 1,
+):
     nbr_ok = None
     if params.churn_down_per_hb == 0.0 and params.churn_up_per_hb == 0.0:
         nbr_ok = neighbor_pull_bool(

@@ -24,7 +24,8 @@ import jax
 import jax.numpy as jnp
 
 from .pull import neighbor_pull_bool, reciprocal_pull_bool
-from .state import PX_POOL_WIDTH, SimParams, SimState
+from .state import (PX_POOL_WIDTH, SimParams, SimState, repair_inert,
+                    restore_repair, strip_repair)
 
 BIG = jnp.float32(1e30)
 
@@ -438,7 +439,6 @@ def heartbeat_step(
     return new_state, deg_out
 
 
-@partial(jax.jit, static_argnames=("params", "steps"))
 def run_heartbeats(
     state: SimState,
     conns: jnp.ndarray,
@@ -450,8 +450,29 @@ def run_heartbeats(
     """lax.scan over heartbeat rounds — simulated time scales in rounds with
     no host sync (the reference's 'long simulated time' axis, SURVEY.md §5).
 
+    The jitted scan is `_run_heartbeats`; this boundary strips the 5
+    mesh-repair leaves from the carry when no repair knob is armed — they
+    are provably untouched then, and carrying them cost the r05 bench ~6
+    passthrough buffers per segment (ops/state.py strip_repair). NOT
+    donated: callers (bench.py, tests) re-run segments from a kept state.
     Jitted with static `steps` so repeated same-length segments (the
     simulator's inter-message gaps) hit the compile cache."""
+    if repair_inert(params):
+        state, saved = strip_repair(state)
+        out = _run_heartbeats(state, conns, rev, out_mask, params, steps)
+        return restore_repair(out, saved)
+    return _run_heartbeats(state, conns, rev, out_mask, params, steps)
+
+
+@partial(jax.jit, static_argnames=("params", "steps"))
+def _run_heartbeats(
+    state: SimState,
+    conns: jnp.ndarray,
+    rev: jnp.ndarray,
+    out_mask: jnp.ndarray,
+    params: SimParams,
+    steps: int,
+) -> SimState:
 
     nbr_ok = None
     valid_pre = None

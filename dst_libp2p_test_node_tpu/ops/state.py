@@ -325,6 +325,36 @@ def init_state(params: SimParams, seed: int = 0) -> SimState:
     )
 
 
+# The mesh-repair leaves ride SimState so repair-armed traces can carry
+# them, but the default (repair-off) compiled step neither reads nor
+# writes any of them — they are pure passthrough at every jit boundary
+# and dead weight in every scan carry. strip_repair/restore_repair excise
+# them HOST-SIDE around the public entrypoints when repair_inert(params):
+# a None field is an empty pytree subtree, so the stripped state traces
+# through the same code with 5 fewer carry/output buffers (the r05 BENCH
+# regression was exactly these buffers riding the publish/heartbeat jits).
+REPAIR_LEAVES = ("px_pool", "starve_hb", "evictions", "px_grafts", "redials")
+
+
+def repair_inert(params: SimParams) -> bool:
+    """True iff no compiled path can read or write the repair leaves —
+    eviction, PX-on-PRUNE, and re-dial are all off (they gate every repair
+    branch behind Python-static `if params.<knob>:` conds)."""
+    return not (params.evict or params.px or params.redial)
+
+
+def strip_repair(state: SimState):
+    """(state without repair leaves, saved dict to restore them later)."""
+    saved = {k: getattr(state, k) for k in REPAIR_LEAVES}
+    return state.replace(**{k: None for k in REPAIR_LEAVES}), saved
+
+
+def restore_repair(state: SimState, saved: dict) -> SimState:
+    """Reattach the leaves strip_repair removed (they were untouched by
+    construction — no inert trace references them)."""
+    return state.replace(**saved)
+
+
 def graph_arrays(graph) -> dict:
     """Move a ConnGraph's arrays to device once (jnp constants per epoch)."""
     return {

@@ -66,7 +66,18 @@ Resilience metrics per trial:
 Warm-start/checkpoint reuse: the experiment's `warm_start` flag threads
 through unchanged (the publish schedule warm-starts its fixpoints), and
 `checkpoint_dir` snapshots each trial post-window via runtime/checkpoint.py
-— a crashed sweep resumes per-trial instead of restarting the campaign.
+plus an `.obs.npz` sidecar with the window's observable curves — a crashed
+sweep resumes per-trial (`_try_resume`, keyed on the epoch-graph hash)
+instead of restarting the campaign, including across trial-group
+boundaries of a sharded run.
+
+Two-level device parallelism: `run_campaign(trial_mesh=...)` takes a 2-D
+(trials x peers) grid from parallel/sharding.make_trial_mesh and shards the
+STACKED TRIAL BATCH over the "trials" device axis — each group scans its
+own sub-batch of a fraction's seed column concurrently, and the batched
+recovery windows ride the same grid. The alternative `mesh=` (1-D peer
+mesh) shards each trial's peer rows instead and keeps trials sequential;
+the two compose at the device-grid level, not per-run.
 """
 
 from __future__ import annotations
@@ -337,15 +348,116 @@ def _obs_metrics(obs: dict, share_floor: float):
     return engaged, float(gf[-1]), recovery, float(share[-1])
 
 
-def _attack_windows(sim: Simulator, attackers, states, adv, steps: int):
-    """Run the attack window for a batch of trials. Un-sharded multi-trial
-    batches stack onto one vmapped scan (the fraction's whole seed column in
-    one device program); sharded or single trials run the plain jit."""
+def sharded_attack_window(stacked, shared: dict, attackers, params, adv,
+                          steps: int, trial_mesh, local_trials: int):
+    """One shard_map program over the "trials" device axis: each trial
+    group runs the vmapped attack window for its local slice of the stacked
+    batch. `stacked` leaves and `attackers` carry a leading trial axis
+    divisible by the mesh's group count; `shared` is the epoch graph dict
+    (replicated into every group). The body names only "trials" in its
+    specs, so it replicates over each group's "peers" submesh
+    (parallel/sharding.make_trial_mesh) — scaling rides the trial axis."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.sharding import TRIAL_AXIS, shard_map
+
+    t, r = P(TRIAL_AXIS), P()
+
+    def group(st, at, cn, rv, om):
+        def one(s, a):
+            return run_attacked_heartbeats(
+                s, cn, rv, om, a, params, adv, steps,
+                batch_factor=local_trials)
+
+        return jax.vmap(one)(st, at)
+
+    return shard_map(
+        group, mesh=trial_mesh, in_specs=(t, t, r, r, r), out_specs=(t, t),
+    )(stacked, attackers, shared["conns"], shared["rev"], shared["out_mask"])
+
+
+def sharded_recovery_window(stacked, shared: dict, attackers, rparams,
+                            steps: int, publisher: int, trial_mesh,
+                            local_trials: int):
+    """The recovery analog of sharded_attack_window: every trial's repair
+    window runs from the shared EPOCH graph (recoveries are independent per
+    trial), and each trial's possibly-dialed graph arrays come back with a
+    leading trial axis for the host to rebind per trial."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.sharding import TRIAL_AXIS, shard_map
+
+    t, r = P(TRIAL_AXIS), P()
+
+    def group(st, at, cn, rv, om):
+        def one(s, a):
+            return run_recovery_heartbeats(
+                s, cn, rv, om, a, rparams, steps, publisher=publisher,
+                batch_factor=local_trials)
+
+        return jax.vmap(one)(st, at)
+
+    return shard_map(
+        group, mesh=trial_mesh, in_specs=(t, t, r, r, r), out_specs=(t, t),
+    )(stacked, attackers, shared["conns"], shared["rev"], shared["out_mask"])
+
+
+def _pad_to_groups(states: list, attackers: list, trial_mesh):
+    """Pad a trial batch to a multiple of the trial-group count by repeating
+    the last trial (extras are dropped after the window). Returns
+    (states, attackers, local_trials)."""
+    from ..parallel.sharding import TRIAL_AXIS
+
+    groups = trial_mesh.shape[TRIAL_AXIS]
+    pad = (-len(states)) % groups
+    states = list(states) + [states[-1]] * pad
+    attackers = list(attackers) + [attackers[-1]] * pad
+    return states, attackers, len(states) // groups
+
+
+def _attack_windows(sim: Simulator, attackers, states, adv, steps: int,
+                    trial_mesh=None):
+    """Run the attack window for a batch of trials. With `trial_mesh` (a 2-D
+    make_trial_mesh grid) the stacked batch shards over the "trials" device
+    axis — each group scans its own sub-batch concurrently. Un-sharded
+    multi-trial batches stack onto one vmapped scan (the fraction's whole
+    seed column in one device program); single trials run the plain jit."""
     import jax
     import jax.numpy as jnp
 
     tree = jax.tree_util.tree_map
     a = sim.arrays
+    if trial_mesh is not None and len(states) > 1:
+        from ..ops.state import repair_inert, restore_repair, strip_repair
+        from ..parallel.sharding import place_trial_batch
+
+        s_count = len(states)
+        states, attackers, local = _pad_to_groups(states, attackers,
+                                                  trial_mesh)
+        # strip the repair leaves host-side, ONCE for the whole batch (the
+        # wrapper inside the mapped body would strip per-trace but still
+        # ship the leaves through the shard_map boundary)
+        saved = None
+        if repair_inert(sim.params):
+            pairs = [strip_repair(s) for s in states]
+            states, saved = [p[0] for p in pairs], [p[1] for p in pairs]
+        stacked = tree(lambda *xs: jnp.stack(xs), *states)
+        att = jnp.stack(attackers)
+        (stacked, att), shared = place_trial_batch((stacked, att), a,
+                                                   trial_mesh)
+        out_states, obs = sharded_attack_window(
+            stacked, shared, att, sim.params, adv, steps, trial_mesh, local)
+        obs_np = tree(np.asarray, obs)
+        outs = []
+        for j in range(s_count):
+            st = tree(lambda x, j=j: x[j], out_states)
+            if saved is not None:
+                st = restore_repair(st, saved[j])
+            outs.append(st)
+        return outs, [{k: v[j] for k, v in obs_np.items()}
+                      for j in range(s_count)]
     if len(states) == 1:
         st, obs = run_attacked_heartbeats(
             states[0], a["conns"], a["rev"], a["out_mask"], attackers[0],
@@ -368,6 +480,73 @@ def _attack_windows(sim: Simulator, attackers, states, adv, steps: int):
     )
 
 
+def _trial_ckpt(cfg: CampaignConfig, fraction: float, seed: int):
+    """(checkpoint, obs-sidecar) paths for one (fraction, seed) cell."""
+    base = os.path.join(cfg.checkpoint_dir,
+                        f"{cfg.scenario}_f{fraction:g}_s{seed}")
+    return base + ".npz", base + ".obs.npz"
+
+
+def _try_resume(sim: Simulator, cfg: CampaignConfig, fraction: float,
+                seed: int):
+    """(post-window state, attack-window obs) recovered from a prior run's
+    per-trial checkpoint + obs sidecar, or None. Identity is the EPOCH
+    graph hash the checkpoint was written against plus the current state
+    layout version — a stale snapshot is silently recomputed, never
+    trusted."""
+    import json
+
+    from flax import serialization
+
+    from .checkpoint import FORMAT_VERSION, _graph_hash
+
+    ck, sc = _trial_ckpt(cfg, fraction, seed)
+    if not (os.path.exists(ck) and os.path.exists(sc)):
+        return None
+    try:
+        z = np.load(ck)
+        meta = json.loads(bytes(z["meta_json"]).decode())
+        if meta["version"] != FORMAT_VERSION:
+            return None
+        if meta.get("graph_sha256") != _graph_hash(sim.graph):
+            return None
+        sd = {k.split("/", 1)[1]: z[k]
+              for k in z.files if k.startswith("state/")}
+        state = serialization.from_state_dict(sim.state, sd)
+        zo = np.load(sc)
+        obs = {k: np.asarray(zo[k]) for k in zo.files}
+    except Exception:
+        return None  # unreadable/truncated snapshot: recompute the trial
+    return state, obs
+
+
+def _recovery_windows_sharded(sim: Simulator, cfg: CampaignConfig,
+                              states: list, attackers: list, pub: int,
+                              trial_mesh):
+    """Batch every trial's recovery window into one shard_map program over
+    the trial groups; returns per-trial ((state, conns, rev, out_mask),
+    obs) in input order. Each trial recovers from the shared EPOCH graph,
+    exactly like the sequential path restores it between trials."""
+    import jax
+    import jax.numpy as jnp
+
+    tree = jax.tree_util.tree_map
+    t_count = len(states)
+    states, attackers, local = _pad_to_groups(states, attackers, trial_mesh)
+    stacked = tree(lambda *xs: jnp.stack(xs), *states)
+    att = jnp.stack(attackers)
+    rparams = cfg.repair.apply(sim.params)
+    outs, obs = sharded_recovery_window(
+        stacked, sim.arrays, att, rparams, cfg.recovery_heartbeats, pub,
+        trial_mesh, local)
+    obs_np = tree(np.asarray, obs)
+    return [
+        (tree(lambda x, j=j: x[j], outs),
+         {k: v[j] for k, v in obs_np.items()})
+        for j in range(t_count)
+    ]
+
+
 def _attacked_trials(
     sim: Simulator,
     cfg: CampaignConfig,
@@ -375,6 +554,7 @@ def _attacked_trials(
     seeds: list[int],
     cache: dict,
     budget: float,
+    trial_mesh=None,
 ) -> list[TrialResult]:
     import jax.numpy as jnp
 
@@ -388,42 +568,78 @@ def _attacked_trials(
     # cold boot joins the network mid-attack: the warmup rounds RUN INSIDE
     # the window (mesh formation under fire), not before it
     steps = cfg.attack_heartbeats + (warm_steps if adv.cold_boot else 0)
+    # no dial can ever commit unless PX or re-dial is armed (repair_round's
+    # dial path is reachable from BOTH, ops/repair.py `use_px`): with both
+    # off the recovery window provably leaves the graph arrays untouched,
+    # so the per-trial rebind_graph — a full edge/answer-table rebuild plus
+    # a wholesale warm-start invalidation, pure r05-regression-class dead
+    # weight here — and the epoch-graph restore are both skipped
+    graph_static = not (cfg.repair.px or cfg.repair.redial)
 
     t0 = time.time()
-    cohorts, states = [], []
+    cohorts: dict[int, tuple] = {}
+    state_by_seed: dict[int, object] = {}
+    obs_by_seed: dict[int, dict] = {}
+    resumed: set[int] = set()
     for s in seeds:
         att = attacker_cohort(n, fraction, seed=s, conns=conns_np,
                               publisher=pub, eclipse=adv.eclipse)
+        cohorts[s] = (att, jnp.asarray(att))
+    if cfg.checkpoint_dir:
+        for s in seeds:
+            got = _try_resume(sim, cfg, fraction, s)
+            if got is not None:
+                state_by_seed[s], obs_by_seed[s] = got
+                resumed.add(s)
+    run_seeds = [s for s in seeds if s not in resumed]
+    run_states = []
+    for s in run_seeds:
         _reset_trial(sim, s)
         if not adv.cold_boot:
             sim.warmup()
-        att_j = jnp.asarray(att)
         if adv.eclipse:
             sim.state = eclipse_setup(sim.state, sim.arrays["conns"],
-                                      att_j, pub)
-        cohorts.append((att, att_j))
-        states.append(sim.state)
+                                      cohorts[s][1], pub)
+        run_states.append(sim.state)
 
-    states, obs_list = _attack_windows(
-        sim, [aj for _, aj in cohorts], states, adv, steps)
+    if run_seeds:
+        w_states, w_obs = _attack_windows(
+            sim, [cohorts[s][1] for s in run_seeds], run_states, adv, steps,
+            trial_mesh=trial_mesh)
+        for j, s in enumerate(run_seeds):
+            state_by_seed[s] = w_states[j]
+            obs_by_seed[s] = w_obs[j]
 
     # the dial controller can mutate the graph arrays per trial; keep the
     # epoch graph to restore before the next trial's reset
     epoch_arrays = dict(sim.arrays)
+    recov = None
+    if (cfg.recovery_heartbeats > 0 and trial_mesh is not None
+            and len(seeds) > 1):
+        recov = _recovery_windows_sharded(
+            sim, cfg, [state_by_seed[s] for s in seeds],
+            [cohorts[s][1] for s in seeds], pub, trial_mesh)
     out = []
     for j, s in enumerate(seeds):
-        att, att_j = cohorts[j]
+        att, att_j = cohorts[s]
         base = _ensure_baseline(sim, cache, s)
         _reset_trial(sim, s)
-        sim.state = states[j]
-        if cfg.checkpoint_dir:
+        sim.state = state_by_seed[s]
+        if cfg.checkpoint_dir and s not in resumed:
             from .checkpoint import save_checkpoint
 
             os.makedirs(cfg.checkpoint_dir, exist_ok=True)
-            save_checkpoint(sim, os.path.join(
-                cfg.checkpoint_dir,
-                f"{cfg.scenario}_f{fraction:g}_s{s}.npz"))
-        obs_j = obs_list[j]
+            ck, sc = _trial_ckpt(cfg, fraction, s)
+            save_checkpoint(sim, ck)
+            # obs sidecar: the engagement/recovery curves span the attack
+            # window the checkpoint already paid for — without them a
+            # resumed trial could restore the state but not its metrics
+            tmp = sc + ".tmp"
+            with open(tmp, "wb") as fh:
+                np.savez_compressed(fh, **{
+                    k: np.asarray(v) for k, v in obs_by_seed[s].items()})
+            os.replace(tmp, sc)
+        obs_j = obs_by_seed[s]
         recovery_time_ms = -1.0
         if cfg.recovery_heartbeats > 0:
             # post-attack repair window. The checkpoint above snapshots the
@@ -431,14 +647,18 @@ def _attacked_trials(
             # hash is the checkpoint identity) — recovery must come after.
             import jax
 
-            rparams = cfg.repair.apply(sim.params)
-            a = sim.arrays
-            (st2, cn2, rv2, om2), robs = run_recovery_heartbeats(
-                sim.state, a["conns"], a["rev"], a["out_mask"], att_j,
-                rparams, cfg.recovery_heartbeats, publisher=pub)
+            if recov is not None:
+                (st2, cn2, rv2, om2), robs = recov[j]
+            else:
+                rparams = cfg.repair.apply(sim.params)
+                a = sim.arrays
+                (st2, cn2, rv2, om2), robs = run_recovery_heartbeats(
+                    sim.state, a["conns"], a["rev"], a["out_mask"], att_j,
+                    rparams, cfg.recovery_heartbeats, publisher=pub)
             robs = jax.tree_util.tree_map(np.asarray, robs)
             sim.state = st2
-            sim.rebind_graph(cn2, rv2, om2)
+            if not graph_static:
+                sim.rebind_graph(cn2, rv2, om2)
             # concatenate the shared observables: engagement/recovery
             # rounds are counted over the whole attack+recovery timeline
             obs_j = {k: np.concatenate(
@@ -483,7 +703,7 @@ def _attacked_trials(
             redials_total=int(np.asarray(sim.state.redials).sum()),
             recovery_time_ms=recovery_time_ms,
         ))
-        if cfg.recovery_heartbeats > 0:
+        if cfg.recovery_heartbeats > 0 and not graph_static:
             # restore the epoch graph: the next trial (and _reset_trial's
             # valid_edge refresh) must start from the built topology
             sim.rebind_graph(epoch_arrays["conns"], epoch_arrays["rev"],
@@ -491,11 +711,24 @@ def _attacked_trials(
     return out
 
 
-def run_campaign(cfg: CampaignConfig, mesh=None) -> CampaignResult:
+def run_campaign(cfg: CampaignConfig, mesh=None,
+                 trial_mesh=None) -> CampaignResult:
     """Execute the sweep: every (fraction, seed) cell of the campaign grid.
-    `mesh`: optional 1-D jax.sharding.Mesh over the peer axis, threaded to
-    the Simulator (row-sharded state + shard_map dissemination); sharded
-    runs keep trials sequential so placement stays row-wise."""
+
+    `mesh`: optional 1-D jax.sharding.Mesh over the PEER axis, threaded to
+    the Simulator (row-sharded state + shard_map dissemination); peer-sharded
+    runs keep trials sequential so placement stays row-wise.
+
+    `trial_mesh`: optional 2-D parallel/sharding.make_trial_mesh grid over
+    the TRIAL axis — each device group runs its slice of a fraction's seed
+    column concurrently (sharded_attack_window / sharded_recovery_window),
+    replacing the vmapped single-device stack. Mutually exclusive with
+    `mesh`: the trial grid already owns every device, and the window bodies
+    replicate over each group's peer submesh."""
+    if mesh is not None and trial_mesh is not None:
+        raise ValueError(
+            "pass either mesh (peer-axis sharding) or trial_mesh "
+            "(trial-axis sharding), not both")
     cfg.validate()
     adv = cfg.adversary_params()
     t0 = time.time()
@@ -515,6 +748,9 @@ def run_campaign(cfg: CampaignConfig, mesh=None) -> CampaignResult:
         if f == 0.0:
             for s in seeds:
                 trials.append(_benign_trial(sim, cfg, s, cache, budget))
+        elif trial_mesh is not None and cfg.vmap_trials and len(seeds) > 1:
+            trials.extend(_attacked_trials(sim, cfg, f, seeds, cache, budget,
+                                           trial_mesh=trial_mesh))
         elif cfg.vmap_trials and len(seeds) > 1 and mesh is None:
             trials.extend(_attacked_trials(sim, cfg, f, seeds, cache, budget))
         else:

@@ -505,6 +505,15 @@ class Simulator:
                 uplink_free_ms=uplink_new, rx_free_ms=rx_new,
             )
             publisher = int(exit_node)
+        # strip the mesh-repair leaves around the publish jit when no knob
+        # is armed: disseminate never touches them, and carrying them as
+        # passthrough outputs cost the r05 bench a copy of all 5 buffers
+        # per publish (ops/state.py strip_repair)
+        from ..ops.state import repair_inert, restore_repair, strip_repair
+
+        saved = None
+        if repair_inert(self.params):
+            self.state, saved = strip_repair(self.state)
         res, self.state = disseminate(
             self.state,
             a["conns"],
@@ -529,6 +538,8 @@ class Simulator:
             # unsubscribed publisher -> gossipsub v1.1 fanout publish
             with_fanout=not bool(self._subscribed_np[publisher]),
         )
+        if saved is not None:
+            self.state = restore_repair(self.state, saved)
         if cfg.msgid_mode == "go":
             # Go/Rust key messages by the embedded LE64 ns timestamp. The
             # sim clock is float32-coarse, so back-to-back publishes could

@@ -130,6 +130,79 @@ def test_bounded_ladder_wait_bar_stays_finite():
             assert r["answer_wait_max_ms"] >= 0.0
 
 
+def _load_bench():
+    spec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(REPO, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules.setdefault("bench", mod)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_bench_tripwire_parses_committed_artifacts(tmp_path):
+    # the metric-of-record JSON lives INSIDE each BENCH_r*.json wrapper's
+    # "tail" string (after any runtime warnings); the tripwire's parser
+    # must dig it out of the live artifacts and out of a synthetic wrapper,
+    # and skip unparseable files instead of crashing
+    bench = _load_bench()
+    best = bench.best_committed_peer_rounds()
+    assert best is not None and best > 25e6  # the r04 31.4M record
+    assert bench.best_committed_peer_rounds(str(tmp_path)) is None
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps(
+        {"n": 1, "rc": 0, "tail": "WARNING: noise\n"
+         '{"metric": "simulated_peer_rounds_per_sec", "value": 123.0}'}))
+    (tmp_path / "BENCH_r02.json").write_text("not json at all")
+    (tmp_path / "BENCH_r03.json").write_text(json.dumps(
+        {"n": 3, "rc": 1, "tail": "crashed before the metric line"}))
+    assert bench.best_committed_peer_rounds(str(tmp_path)) == 123.0
+
+
+def test_bench_tripwire_wiring_orders_error_before_exit():
+    # the regression artifact must still be a complete strict-JSON line
+    # (error field included) BEFORE the nonzero exit — the driver captures
+    # the detail block either way
+    src = open(os.path.join(REPO, "bench.py")).read()
+    assert '"vs_best_committed"' in src
+    assert "REGRESSION_TOLERANCE" in src
+    assert 'out["error"]' in src
+    emit = src.index("json.dumps(out")
+    assert src.index('out["error"]') < emit
+    assert emit < src.index("raise SystemExit(1)")
+
+
+def test_attack_ladder_row_gates(tmp_path):
+    # config 7 (the committed sharded attack row) has its own gates: a live
+    # attack_trials_per_s series, engagement within the closed-form budget,
+    # and an honest-coverage floor looser than the churn-free 0.999
+    def row(**over):
+        r = _r(7, peers=2048)
+        r.update({"attack_trials_per_s": 0.15, "hb_to_graylist": 8,
+                  "hb_budget": 8.0})
+        r.update(over)
+        return r
+
+    x = str(tmp_path / "x")
+    assert bc.check_results([row()], x) == []
+    assert bc.check_results([row(coverage=0.995)], x) == []  # own floor
+    assert any("coverage" in f
+               for f in bc.check_results([row(coverage=0.98)], x))
+    assert any("budget" in f
+               for f in bc.check_results([row(hb_to_graylist=9)], x))
+    assert any("engaged" in f
+               for f in bc.check_results([row(hb_to_graylist=None)], x))
+    assert any("trials_per_s" in f
+               for f in bc.check_results([row(attack_trials_per_s=0.0)], x))
+
+
+def test_committed_attack_row_inside_its_gates():
+    # the committed config-7 row must itself pass the gate it ships with
+    with open(bc.ARTIFACT) as f:
+        rows = [json.loads(x) for x in f if x.strip()]
+    r7 = [r for r in rows if r["config"] == 7]
+    assert r7, "BENCH_CONFIGS.json must carry the attack ladder row"
+    assert bc.check_results(r7) == []
+
+
 def test_bench_guards_repair_probe():
     # the repair probe (ISSUE 4) must refuse to emit an artifact where the
     # recovery window did nothing: zero evictions or a GROWING attacker

@@ -1,0 +1,198 @@
+"""Two-level device parallelism: the trial-axis sharded campaign.
+
+`run_campaign(trial_mesh=...)` partitions a fraction's seed column across
+device groups (parallel/sharding.make_trial_mesh) instead of stacking the
+whole column onto one vmapped device program. The contracts pinned here:
+
+  - sharded == vmapped: the same grid produces the same trial metrics
+    (rtol 1e-5) on >= 2 device groups — the shard boundary moves placement,
+    never numerics (batch_factor is a memory-dispatch hint; both gather
+    forms are exact).
+  - zero-attacker trials stay on the benign path bit-identically, sharded
+    or not.
+  - per-trial checkpoint + obs-sidecar resume works ACROSS group
+    boundaries: a sweep checkpointed under one trial grid resumes under a
+    different one (the checkpoint identity is the epoch-graph hash, which
+    is grid-independent).
+  - the r05 dead-weight fix: with the repair subsystem off (the default),
+    the public heartbeat/adversary entrypoints carry the five repair
+    leaves AROUND the scan (strip_repair/restore_repair, ops/state.py),
+    not through it — the leaves come back as the SAME buffers, which is
+    impossible if they rode the scan carry.
+
+conftest.py forces 8 virtual CPU devices, so the 2- and 4-group meshes are
+real multi-device placements here.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from dst_libp2p_test_node_tpu.config.topology import TopoParams
+from dst_libp2p_test_node_tpu.ops.adversary import (
+    AdversaryParams, attacker_cohort, run_attacked_heartbeats,
+)
+from dst_libp2p_test_node_tpu.ops.graph import build_connection_graph
+from dst_libp2p_test_node_tpu.ops.heartbeat import run_heartbeats
+from dst_libp2p_test_node_tpu.ops.repair import RepairParams
+from dst_libp2p_test_node_tpu.ops.state import (
+    REPAIR_LEAVES, SimParams, graph_arrays, init_state, repair_inert,
+)
+from dst_libp2p_test_node_tpu.parallel.sharding import (
+    TRIAL_AXIS, make_trial_mesh,
+)
+from dst_libp2p_test_node_tpu.runtime.campaign import (
+    CampaignConfig, attack_gossipsub, run_campaign,
+)
+from dst_libp2p_test_node_tpu.runtime.simulator import ExperimentConfig
+
+
+def _exp(n=64, seed=0, messages=2):
+    return ExperimentConfig(
+        topo=TopoParams(network_size=n, anchor_stages=2, min_bandwidth=50,
+                        max_bandwidth=150, min_latency=40, max_latency=130,
+                        msg_size_bytes=2000, messages=messages,
+                        delay_seconds=1.0),
+        connect_to=8, gossipsub=attack_gossipsub(), warmup_s=8.0, seed=seed)
+
+
+def _cfg(**over):
+    kw = dict(fractions=(0.0, 0.2), seeds=(0, 1, 2, 3), experiment=_exp(),
+              attack_heartbeats=6)
+    kw.update(over)
+    return CampaignConfig(**kw)
+
+
+# numeric TrialResult fields compared between the sharded and vmapped runs
+_COMPARE = ("honest_coverage", "benign_coverage", "latency_p50_ms",
+            "latency_p99_ms", "latency_inflation", "graylisted_frac_final",
+            "attacker_mesh_share_final", "attacker_score_final",
+            "recovery_time_ms")
+_EXACT = ("attackers", "hb_to_graylist", "mesh_recovery_hb",
+          "mesh_evictions_total", "px_grafts_total", "redials_total")
+
+
+def _assert_trials_close(a, b, rtol=1e-5):
+    assert len(a) == len(b)
+    for ta, tb in zip(a, b):
+        assert (ta.fraction, ta.seed) == (tb.fraction, tb.seed)
+        for k in _EXACT:
+            assert getattr(ta, k) == getattr(tb, k), (k, ta.seed)
+        for k in _COMPARE:
+            np.testing.assert_allclose(
+                getattr(ta, k), getattr(tb, k), rtol=rtol,
+                err_msg=f"{k} diverged at seed {ta.seed}")
+
+
+def test_trial_mesh_shape_and_divisibility():
+    m = make_trial_mesh(2, n_devices=4)
+    assert m.shape == {TRIAL_AXIS: 2, "peers": 2}
+    assert make_trial_mesh(n_devices=4).shape[TRIAL_AXIS] == 4
+    with pytest.raises(ValueError):
+        make_trial_mesh(3, n_devices=4)
+
+
+@pytest.mark.parametrize("groups", [2, 4])
+def test_sharded_campaign_equals_vmapped(groups):
+    r_v = run_campaign(_cfg())
+    tm = make_trial_mesh(groups, n_devices=groups)
+    r_s = run_campaign(_cfg(), trial_mesh=tm)
+    _assert_trials_close(r_v.trials, r_s.trials)
+
+
+def test_zero_attacker_trials_identical_under_sharding():
+    # fraction-0.0 cells take the benign Simulator path whether or not a
+    # trial mesh is live; their metrics must be EXACTLY equal, not rtol
+    r_v = run_campaign(_cfg())
+    r_s = run_campaign(_cfg(), trial_mesh=make_trial_mesh(4, n_devices=4))
+    for tv, ts in zip(r_v.trials, r_s.trials):
+        if tv.fraction == 0.0:
+            assert tv.honest_coverage == ts.honest_coverage
+            assert tv.latency_p50_ms == ts.latency_p50_ms
+            assert tv.latency_p99_ms == ts.latency_p99_ms
+
+
+def test_sharded_recovery_window_equals_sequential():
+    rep = RepairParams(evict=True, px=True, redial=True)
+    r_v = run_campaign(_cfg(fractions=(0.2,), recovery_heartbeats=4,
+                            repair=rep))
+    r_s = run_campaign(_cfg(fractions=(0.2,), recovery_heartbeats=4,
+                            repair=rep),
+                       trial_mesh=make_trial_mesh(2, n_devices=2))
+    _assert_trials_close(r_v.trials, r_s.trials)
+
+
+def test_checkpoint_resume_across_group_boundaries(tmp_path):
+    d = str(tmp_path / "ck")
+    c1 = _cfg(fractions=(0.2,), checkpoint_dir=d)
+    r1 = run_campaign(c1, trial_mesh=make_trial_mesh(4, n_devices=4))
+    written = sorted(os.listdir(d))
+    assert len(written) == 8  # 4 trial checkpoints + 4 obs sidecars
+    mtimes = {f: os.path.getmtime(os.path.join(d, f)) for f in written}
+    # resume the SAME sweep under a different trial grid: the checkpoint
+    # identity (epoch-graph hash) is grid-independent, so every trial must
+    # resume — no snapshot may be rewritten — and the metrics must match
+    c2 = _cfg(fractions=(0.2,), checkpoint_dir=d)
+    r2 = run_campaign(c2, trial_mesh=make_trial_mesh(2, n_devices=2))
+    assert {f: os.path.getmtime(os.path.join(d, f))
+            for f in sorted(os.listdir(d))} == mtimes
+    _assert_trials_close(r1.trials, r2.trials)
+
+
+def test_stale_checkpoint_is_recomputed_not_trusted(tmp_path):
+    d = str(tmp_path / "ck")
+    r1 = run_campaign(_cfg(fractions=(0.2,), checkpoint_dir=d),
+                      trial_mesh=make_trial_mesh(2, n_devices=2))
+    # truncate one snapshot: the resume scan must silently recompute that
+    # trial instead of crashing or loading garbage
+    victim = sorted(f for f in os.listdir(d) if not f.endswith(".obs.npz"))[0]
+    with open(os.path.join(d, victim), "wb") as fh:
+        fh.write(b"\x00" * 16)
+    r2 = run_campaign(_cfg(fractions=(0.2,), checkpoint_dir=d),
+                      trial_mesh=make_trial_mesh(2, n_devices=2))
+    _assert_trials_close(r1.trials, r2.trials)
+
+
+def _make_op_fixture(n=64, connect_to=8, seed=0, **over):
+    g = build_connection_graph(n, connect_to, seed=seed)
+    params = SimParams(n=n, capacity=g.capacity, **over)
+    return params, init_state(params, seed=seed), graph_arrays(g)
+
+
+def test_inert_repair_leaves_ride_around_the_scan():
+    # the r05 regression: the five repair leaves ((N,8) px_pool and four
+    # (N,) counters) rode every default scan carry as dead weight. With
+    # repair off the public wrapper must strip them before the jit and
+    # restore the ORIGINAL buffers after — object identity proves the scan
+    # never carried them
+    params, state, a = _make_op_fixture()
+    assert repair_inert(params)
+    out = run_heartbeats(state, a["conns"], a["rev"], a["out_mask"],
+                         params, 3)
+    for k in REPAIR_LEAVES:
+        assert getattr(out, k) is getattr(state, k), (
+            f"{k} was carried through the inert scan")
+    # an ARMED config must thread them through the scan (fresh buffers)
+    armed = RepairParams(evict=True).apply(params)
+    assert not repair_inert(armed)
+    out2 = run_heartbeats(state, a["conns"], a["rev"], a["out_mask"],
+                          armed, 3)
+    for k in REPAIR_LEAVES:
+        assert getattr(out2, k) is not getattr(state, k)
+
+
+def test_inert_repair_leaves_stripped_from_attack_window():
+    params, state, a = _make_op_fixture(
+        slow_weight=-10.0, slow_decay=0.9, graylist_threshold=-50.0,
+        gossip_threshold=-10.0, publish_threshold=-20.0)
+    assert repair_inert(params)
+    att = jnp.asarray(attacker_cohort(params.n, 0.1, seed=0))
+    adv = AdversaryParams(scenario="sybil_graft_flood")
+    out, _obs = run_attacked_heartbeats(
+        state, a["conns"], a["rev"], a["out_mask"], att, params, adv, 3)
+    for k in REPAIR_LEAVES:
+        assert getattr(out, k) is getattr(state, k), (
+            f"{k} was carried through the attack-window scan")
