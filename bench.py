@@ -52,13 +52,42 @@ MESSAGES = 3             # timed dissemination fixpoints (one per ~100 rounds)
 # tripwire fires (module docstring "Regression tripwire")
 REGRESSION_TOLERANCE = 0.20
 
+# the workload identity this bench run measures: the tripwire only compares
+# against committed artifacts of the SAME config, so a heavier rung (the r05
+# 15 KB-payload bounded run) neither masks nor falsely trips a regression
+# against the light pre-r05 configs
+BENCH_CONFIG = f"n{N_PEERS}-r{HB_ROUNDS}-m{MESSAGES}-bounded"
 
-def best_committed_peer_rounds(repo_root: str | None = None) -> float | None:
+
+def _config_key_of(rec: dict) -> str:
+    """Config key of a committed metric record. Precedence: the explicit
+    detail.bench_config field (artifacts from this revision on), else a key
+    derived from the workload-shape fields (the r05 artifact predates the
+    explicit field but carries delivery_mode), else the legacy pre-r05
+    light-config bucket (those artifacts all ran the 2 KB-payload
+    exact-delivery workload and are only comparable to each other)."""
+    d = rec.get("detail") or {}
+    explicit = d.get("bench_config")
+    if explicit:
+        return str(explicit)
+    mode = d.get("delivery_mode")
+    if mode and all(d.get(k) is not None
+                    for k in ("n_peers", "rounds", "timed_messages")):
+        return (f"n{d['n_peers']}-r{d['rounds']}-m{d['timed_messages']}"
+                f"-{mode}")
+    return "pre-r5-light"
+
+
+def best_committed_peer_rounds(
+    repo_root: str | None = None, config_key: str | None = None,
+) -> float | None:
     """Best metric-of-record value across the committed BENCH_r*.json
     artifacts, or None when none parse. Each artifact is the driver's wrapper
     {"n", "cmd", "rc", "tail"} — the bench's own JSON line lives INSIDE the
     "tail" string (after any warnings), so this scans tail lines for the
-    {"metric": "simulated_peer_rounds_per_sec", ...} record."""
+    {"metric": "simulated_peer_rounds_per_sec", ...} record. With config_key
+    set, only records whose _config_key_of matches count — the per-config
+    tripwire keying; None keeps the global best (analysis tooling)."""
     import glob
     import os
 
@@ -79,6 +108,8 @@ def best_committed_peer_rounds(repo_root: str | None = None) -> float | None:
             except json.JSONDecodeError:
                 continue
             if rec.get("metric") != "simulated_peer_rounds_per_sec":
+                continue
+            if config_key is not None and _config_key_of(rec) != config_key:
                 continue
             v = rec.get("value")
             if isinstance(v, (int, float)) and (best is None or v > best):
@@ -402,8 +433,9 @@ def main() -> None:
     delays = np.stack([np.asarray(r.delay_ms) for r in results])
     ok = delays < 1e30
     coverage = float(ok.mean())
-    # regression tripwire vs the best committed artifact (module docstring)
-    best = best_committed_peer_rounds()
+    # regression tripwire vs the best committed artifact OF THIS CONFIG
+    # (module docstring; _config_key_of keys the committed records)
+    best = best_committed_peer_rounds(config_key=BENCH_CONFIG)
     import os as _os
 
     trip_env = _os.environ.get("BENCH_TRIPWIRE", "")
@@ -421,6 +453,8 @@ def main() -> None:
         "vs_best_committed": (round(value / best, 3)
                               if best is not None else None),
         "detail": {
+            # explicit workload identity for the per-config tripwire keying
+            "bench_config": BENCH_CONFIG,
             "n_peers": N_PEERS,
             "rounds": rounds,
             "wall_s": round(wall, 3),

@@ -128,6 +128,31 @@ def _attack_spec() -> TraceSpec:
         kwargs=dict(params=params, adv=AdversaryParams(), steps=4))
 
 
+def _faults_spec() -> TraceSpec:
+    import jax.numpy as jnp
+
+    from ..ops.adversary import AdversaryParams, attacker_cohort
+    from ..ops.faults import FaultParams, fault_masks, run_faulted_heartbeats
+
+    g, params, state, a, _ = _single_topic(**_ARMED)
+    att = jnp.asarray(attacker_cohort(params.n, 0.25, seed=1))
+    # every fault family armed at once: crash + partition + spike windows
+    # overlapping, composed with an active adversary cohort — the maximal
+    # program, so a cond lost in ANY family fails the audit
+    faults = FaultParams(
+        crash_frac=0.2, crash_window=(0, 2),
+        partition_frac=0.3, partition_window=(1, 3),
+        spike_frac=0.2, spike_window=(0, 4), spike_ms=250.0)
+    fm = fault_masks(params.n, faults, seed=1, publisher=3)
+    return TraceSpec(
+        fn=run_faulted_heartbeats,
+        args=(state, a["conns"], a["rev"], a["out_mask"], att),
+        kwargs=dict(params=params, adv=AdversaryParams(), faults=faults,
+                    crash=jnp.asarray(fm["crash"]),
+                    side=jnp.asarray(fm["side"]),
+                    spike=jnp.asarray(fm["spike"]), steps=4))
+
+
 def _sharded_attack_spec() -> TraceSpec:
     import jax
     import jax.numpy as jnp
@@ -390,6 +415,17 @@ def default_contracts() -> list[EntrypointContract]:
             notes="recovery scan: 6 armed-heartbeat conds + the repair "
                   "controller's single action cond, all inside the scan "
                   "body; the graph arrays ride the carry"),
+        EntrypointContract(
+            name="faults/churn_window",
+            build=_faults_spec,
+            expected_conds=None,
+            feedback=[(_first_out, _state_arg_of)],
+            notes="fault window with crash + partition + spike all armed "
+                  "over an attacked mesh: the go-dark/restart and "
+                  "freeze/thaw branches are window-scheduled lax.conds "
+                  "inside the scan; state must feed back aval-stable so "
+                  "retried trials resume from a checkpoint without a "
+                  "recompile"),
         EntrypointContract(
             name="campaign/attack_window_sharded",
             build=_sharded_attack_spec,

@@ -373,6 +373,36 @@ def cmd_attack(argv: list[str]) -> int:
                    help="starved peers (mesh degree < D_lo for "
                    "--redial-patience heartbeats) dial new connections")
     p.add_argument("--redial-patience", type=int, default=3)
+    # fault-injection subsystem (ops/faults.py): scheduled windows are in
+    # heartbeat-round indices A:B relative to the attack window, half-open
+    p.add_argument("--crash-frac", type=float, default=0.0,
+                   help="fraction of non-publisher peers that crash for "
+                   "--crash-window and restart with cold mesh/score state")
+    p.add_argument("--crash-window", default="0:0", metavar="A:B",
+                   help="heartbeat rounds [A, B) the crash cohort is dark")
+    p.add_argument("--partition-frac", type=float, default=0.0,
+                   help="fraction of peers cut onto the far side of a "
+                   "two-component graph partition")
+    p.add_argument("--partition-window", default="0:0", metavar="A:B",
+                   help="heartbeat rounds [A, B) the partition is up")
+    p.add_argument("--spike-frac", type=float, default=0.0,
+                   help="fraction of peers whose uplink clocks take a "
+                   "latency spike during --spike-window")
+    p.add_argument("--spike-window", default="0:0", metavar="A:B",
+                   help="heartbeat rounds [A, B) of the latency spike")
+    p.add_argument("--spike-ms", type=float, default=0.0,
+                   help="extra uplink serialization delay per spiked peer")
+    # trial supervisor (SupervisorConfig): timeout + bounded retry/backoff
+    p.add_argument("--trial-timeout-s", type=float, default=0.0,
+                   help="wall-clock ceiling per trial batch attempt "
+                   "(0 = no timeout)")
+    p.add_argument("--max-retries", type=int, default=2,
+                   help="retry budget per trial cell before quarantine")
+    p.add_argument("--retry-backoff-s", type=float, default=0.5,
+                   help="base of the exponential retry backoff")
+    p.add_argument("--inject-failures", type=int, default=0,
+                   help="force the first N trial attempts to fail "
+                   "(supervisor smoke-test hook)")
     p.add_argument("--json", default=None,
                    help="write the campaign result as strict JSON here")
     p.add_argument("--metrics-out", default=None,
@@ -380,10 +410,18 @@ def cmd_attack(argv: list[str]) -> int:
                    "dst_testnode_attack_* series here")
     a = p.parse_args(argv)
 
+    def _window(spec: str, flag: str) -> tuple[int, int]:
+        try:
+            lo, hi = spec.split(":")
+            return int(lo), int(hi)
+        except ValueError:
+            p.error(f"{flag} must be A:B heartbeat indices, got {spec!r}")
+
     from .ops.adversary import AdversaryParams
+    from .ops.faults import FaultParams
     from .ops.repair import RepairParams
     from .runtime.campaign import (
-        CampaignConfig, attack_gossipsub, run_campaign)
+        CampaignConfig, SupervisorConfig, attack_gossipsub, run_campaign)
     from .runtime.simulator import ExperimentConfig
     from .runtime.summarize import report_campaign
 
@@ -418,6 +456,20 @@ def cmd_attack(argv: list[str]) -> int:
             evict=a.evict, eviction_threshold=a.eviction_threshold,
             px=a.px, px_count=a.px_count,
             redial=a.redial, redial_patience=a.redial_patience),
+        faults=FaultParams(
+            crash_frac=a.crash_frac,
+            crash_window=_window(a.crash_window, "--crash-window"),
+            partition_frac=a.partition_frac,
+            partition_window=_window(a.partition_window,
+                                     "--partition-window"),
+            spike_frac=a.spike_frac,
+            spike_window=_window(a.spike_window, "--spike-window"),
+            spike_ms=a.spike_ms),
+        supervisor=SupervisorConfig(
+            trial_timeout_s=a.trial_timeout_s,
+            max_retries=a.max_retries,
+            retry_backoff_s=a.retry_backoff_s,
+            inject_failures=a.inject_failures),
     )
     mesh = None
     if a.mesh:

@@ -78,6 +78,21 @@ SCENARIOS = (
     "censorship",
     "eclipse_publisher",
     "cold_boot_join",
+    # the two static-canon stragglers from arXiv:2007.02754 (ROADMAP):
+    #   slow_peer_mimicry    the attacker meters its own misbehavior so its
+    #                        score in every honest peer's view sits at
+    #                        mimic_margin * (G/w) — just ABOVE the graylist
+    #                        floor, below the gossip/publish thresholds: it
+    #                        contributes nothing, censors everything, and
+    #                        the threshold defenses never quite fire.
+    #   identity_rotation    graft-flood whose sybils rotate identities
+    #                        every rotation_period_hb heartbeats: the honest
+    #                        side's per-edge counters (fmd, penalty,
+    #                        backoff) reset — a "new peer" on the same
+    #                        socket slots — so the accrual race restarts
+    #                        before the graylist budget is spent.
+    "slow_peer_mimicry",
+    "identity_rotation",
 )
 
 
@@ -99,6 +114,13 @@ class AdversaryParams:
     # iwant_answer_ms (the amplification factor)
     spam_iwants_per_hb: int = 16
     iwant_answer_ms: float = 2.0
+    # slow_peer_mimicry: pin the attacker's per-edge penalty counter at
+    # mimic_margin * c_req (c_req = graylist_threshold / slow_weight), i.e.
+    # the score sits at mimic_margin * graylist_threshold — just above the
+    # floor for any margin < 1
+    mimic_margin: float = 0.9
+    # identity_rotation: heartbeats between identity scrubs
+    rotation_period_hb: int = 4
 
     def validate(self) -> None:
         if self.scenario not in SCENARIOS:
@@ -112,13 +134,19 @@ class AdversaryParams:
             raise ValueError("spam_iwants_per_hb must be >= 1")
         if self.iwant_answer_ms < 0.0:
             raise ValueError("iwant_answer_ms must be >= 0")
+        if not (0.0 < self.mimic_margin < 1.0):
+            raise ValueError("mimic_margin must be in (0, 1) — at >= 1 the "
+                             "mimic graylists itself, defeating the scenario")
+        if self.rotation_period_hb < 2:
+            raise ValueError("rotation_period_hb must be >= 2 (a period of 1 "
+                             "scrubs every round: no accrual ever survives)")
 
     # scenario -> active behaviors (all derived, keeping the dataclass a
     # pure static key: one flag per scenario would multiply trace keys)
     @property
     def graft_flood(self) -> bool:
         return self.scenario in ("sybil_graft_flood", "eclipse_publisher",
-                                 "cold_boot_join")
+                                 "cold_boot_join", "identity_rotation")
 
     @property
     def ihave_spam(self) -> bool:
@@ -135,6 +163,14 @@ class AdversaryParams:
     @property
     def cold_boot(self) -> bool:
         return self.scenario == "cold_boot_join"
+
+    @property
+    def slow_mimicry(self) -> bool:
+        return self.scenario == "slow_peer_mimicry"
+
+    @property
+    def identity_rotation(self) -> bool:
+        return self.scenario == "identity_rotation"
 
 
 def attacker_cohort(
@@ -206,7 +242,21 @@ def heartbeats_to_graylist(adv: AdversaryParams, params: SimParams) -> float:
     form) holds with eviction on or off. tests/test_repair.py pins this by
     bit-comparing the graylisted_frac curves across both modes. The spam
     scenarios never consult mesh/backoff in their violation predicate, so
-    they are trivially invariant."""
+    they are trivially invariant.
+
+    SLOW-PEER MIMICRY returns inf by construction: the attacker pins its own
+    counter at mimic_margin * c_req every round, so the graylist can never
+    engage — inf is the scenario's finding, not a config error (run_campaign
+    exempts it from the inf-budget guard).
+
+    IDENTITY ROTATION scrubs the honest side's per-edge counters every
+    rotation_period_hb rounds. A scrub at round m*period leaves violations
+    accruing only in rounds m*period+1 .. (m+1)*period-1, so the graylist
+    engages iff the un-rotated budget fits strictly inside one rotation
+    cycle; the boundary budget == period is conservatively reported inf
+    (engagement there depends on cycle alignment)."""
+    if adv.slow_mimicry:
+        return math.inf
     if params.slow_weight >= 0.0:
         return math.inf  # thresholds_can_bind is False: defenses compiled out
     c_req = params.graylist_threshold / params.slow_weight
@@ -214,11 +264,15 @@ def heartbeats_to_graylist(adv: AdversaryParams, params: SimParams) -> float:
     d = params.slow_decay
     lead_in = 1.0 if (adv.ihave_spam or adv.iwant_spam) else 2.0
     if c_req <= p:
-        return lead_in  # first accrual already crosses
-    rhs = 1.0 - c_req * (1.0 - d) / p
-    if rhs <= 0.0:
+        base = lead_in  # first accrual already crosses
+    else:
+        rhs = 1.0 - c_req * (1.0 - d) / p
+        if rhs <= 0.0:
+            return math.inf
+        base = lead_in - 1.0 + math.ceil(math.log(rhs) / math.log(d))
+    if adv.identity_rotation and base >= adv.rotation_period_hb:
         return math.inf
-    return lead_in - 1.0 + math.ceil(math.log(rhs) / math.log(d))
+    return base
 
 
 def censor_mask(attacker: jnp.ndarray, conns: jnp.ndarray) -> jnp.ndarray:
@@ -256,26 +310,58 @@ def adversary_round(
     adv: AdversaryParams,
     batch_factor: int = 1,
     nbr_ok: jnp.ndarray | None = None,
+    edge_ok: jnp.ndarray | None = None,
+    hb_idx: jnp.ndarray | None = None,
 ):
     """One heartbeat of attacker behavior + honest defense accounting,
     applied AFTER heartbeat_step. Returns (new_state, obs) where obs holds
     the per-round scalar observables the campaign's engagement/recovery
-    metrics are built from. All ops are fixed-shape masked array passes."""
+    metrics are built from. All ops are fixed-shape masked array passes.
+
+    `edge_ok`: the same per-edge availability mask heartbeat_step takes
+    (ops/faults.py) — a partitioned edge carries no attack traffic either.
+    `hb_idx`: the scan's 0-based round index; required (traced, from the
+    scan xs) when adv.identity_rotation so the scrub cadence is part of the
+    compiled program, ignored otherwise."""
+    if adv.identity_rotation and hb_idx is None:
+        raise ValueError("identity_rotation needs the scan round index "
+                         "(hb_idx) to schedule the identity scrubs")
     t = state.t_ms
     if nbr_ok is None:
         nbr_ok = neighbor_pull_bool(
             state.alive & state.subscribed, conns, rev, batch_factor)
     valid = ((conns >= 0) & state.alive[:, None] & nbr_ok
              & state.subscribed[:, None])
+    if edge_ok is not None:
+        valid = valid & edge_ok
     att_row = attacker[:, None] & valid   # attacker out-edges
     honest = ~attacker & state.alive & state.subscribed
 
     mesh = state.mesh_mask
     slow_penalty = state.slow_penalty
     uplink_free_ms = state.uplink_free_ms
+    backoff_until = state.backoff_until
+    fmd = state.fmd
     grafts, grafts_rx = state.grafts, state.grafts_rx
     ihave_tx, ihave_rx = state.ihave_tx, state.ihave_rx
     iwant_tx, iwant_rx = state.iwant_tx, state.iwant_rx
+
+    if adv.identity_rotation:
+        # rotation round: every edge incident to an attacker carries "a new
+        # peer on the same socket slot" — the honest side's per-edge memory
+        # of the old identity (mesh membership, delivery credit, penalty
+        # counter, backoff) resets, and so does the attacker's own row.
+        # Under a lax.cond: off-cadence rounds pay a scalar probe only.
+        def _scrub(m, sl, f, b):
+            inc = (attacker[:, None] | neighbor_pull_bool(
+                attacker, conns, rev, batch_factor)) & (conns >= 0)
+            return (m & ~inc, jnp.where(inc, 0.0, sl),
+                    jnp.where(inc, 0.0, f), jnp.where(inc, 0.0, b))
+
+        rot = (hb_idx % adv.rotation_period_hb) == (adv.rotation_period_hb - 1)
+        mesh, slow_penalty, fmd, backoff_until = jax.lax.cond(
+            rot, _scrub, lambda m, sl, f, b: (m, sl, f, b),
+            mesh, slow_penalty, fmd, backoff_until)
 
     if adv.graft_flood:
         # the attacker GRAFTs every valid edge, every heartbeat, ignoring
@@ -286,8 +372,12 @@ def adversary_round(
         # penalty for exactly this).
         flood = att_row
         rx = reciprocal_pull_bool(flood, conns, rev, batch_factor)
-        violation = rx & ((state.backoff_until > t) | mesh)
-        sc = state.score(params)
+        violation = rx & ((backoff_until > t) | mesh)
+        # rotation reads the POST-scrub counters (a fresh identity is
+        # accepted); every other scenario reads state.* untouched, keeping
+        # those traces bit-identical to the pre-rotation engine
+        sc = (state.replace(fmd=fmd, slow_penalty=slow_penalty).score(params)
+              if adv.identity_rotation else state.score(params))
         accept = rx & ~violation & (sc >= 0.0)
         mesh = (mesh | flood | accept) & valid
         slow_penalty = slow_penalty + jnp.where(
@@ -336,12 +426,34 @@ def adversary_round(
         slow_penalty = slow_penalty + jnp.where(
             rx_req, jnp.float32(adv.violation_penalty), 0.0)
 
+    if adv.slow_mimicry and params.slow_weight < 0.0:
+        # the attacker meters its own misbehavior so the penalty counter on
+        # every edge viewing an attacker sits at mimic_margin * c_req: the
+        # attacker's score in the honest peer's view is mimic_margin *
+        # graylist_threshold — below the gossip/publish thresholds (it is
+        # never gossiped to and is skipped at publish) yet above the
+        # graylist and eviction floors, so it is never refused, never
+        # evicted. Re-pinned every heartbeat: decay and the post-publish
+        # censorship penalty are both clamped back onto the pin.
+        c_req = params.graylist_threshold / params.slow_weight
+        att_view = neighbor_pull_bool(attacker, conns, rev, batch_factor)
+        slow_penalty = jnp.where(
+            valid & att_view,
+            jnp.float32(adv.mimic_margin * c_req), slow_penalty)
+
+    rotation_extra = {}
+    if adv.identity_rotation:
+        # the scrub is the only writer of these two leaves; keeping them
+        # out of the replace on every other scenario keeps those traces
+        # bit-identical to the pre-rotation engine
+        rotation_extra = dict(fmd=fmd, backoff_until=backoff_until)
     new_state = state.replace(
         mesh_mask=mesh, slow_penalty=slow_penalty,
         uplink_free_ms=uplink_free_ms,
         grafts=grafts, grafts_rx=grafts_rx,
         ihave_tx=ihave_tx, ihave_rx=ihave_rx,
         iwant_tx=iwant_tx, iwant_rx=iwant_rx,
+        **rotation_extra,
     )
 
     obs = attack_observables(new_state, conns, rev, attacker, params,
@@ -441,14 +553,19 @@ def _run_attacked_heartbeats(
         nbr_ok = neighbor_pull_bool(
             state.alive & state.subscribed, conns, rev, batch_factor)
 
-    def body(s, _):
+    # identity rotation needs the round index inside the compiled body (the
+    # scrub cadence); every other scenario scans over nothing, as before
+    xs = jnp.arange(steps) if adv.identity_rotation else None
+
+    def body(s, hb):
         s = heartbeat_step(s, conns, rev, out_mask, params,
                            batch_factor=batch_factor, nbr_ok=nbr_ok)
         s, obs = adversary_round(s, conns, rev, attacker, params, adv,
-                                 batch_factor=batch_factor, nbr_ok=nbr_ok)
+                                 batch_factor=batch_factor, nbr_ok=nbr_ok,
+                                 hb_idx=hb)
         return s, obs
 
-    return jax.lax.scan(body, state, None, length=steps)
+    return jax.lax.scan(body, state, xs, length=steps)
 
 
 def censorship_penalty_update(

@@ -72,6 +72,7 @@ def heartbeat_step(
     valid_pre: jnp.ndarray | None = None,
     decay_scales=None,
     deg_in: jnp.ndarray | None = None,
+    edge_ok: jnp.ndarray | None = None,
 ):
     """`batch_factor`: width of any enclosing vmap (e.g. the topic axis of
     runtime/multitopic.py) so the pull memory dispatch sees the true
@@ -99,9 +100,16 @@ def heartbeat_step(
     preserved, the per-step (N, C) mesh-AND and degree reduce both
     disappear, and the degree is re-reduced only inside a cond when a
     branch actually changed the mesh. When given, the step returns
-    (state, deg_out) instead of state."""
+    (state, deg_out) instead of state.
+
+    `edge_ok`: optional (N, C) per-edge availability mask ANDed into the
+    validity conjunction — the fault-injection hook (ops/faults.py): a
+    partitioned edge is connected but unusable, so it falls out of `valid`
+    exactly like an edge to a dead peer. None keeps the default trace
+    untouched (the same optional-arg contract as nbr_ok/valid_pre)."""
     if deg_in is not None and (
         valid_pre is None
+        or edge_ok is not None
         or params.churn_down_per_hb > 0.0
         or params.churn_up_per_hb > 0.0
     ):
@@ -109,8 +117,8 @@ def heartbeat_step(
         # hoisted validity mask with churn off; reject misuse loudly (the
         # degrees would silently count edges to dead/unsubscribed peers,
         # or the return arity would silently change under churn)
-        raise ValueError("deg_in requires valid_pre and churn off "
-                         "(run_heartbeats' churn-free scan protocol)")
+        raise ValueError("deg_in requires valid_pre, no edge_ok, and churn "
+                         "off (run_heartbeats' churn-free scan protocol)")
     n, c = conns.shape
     key, k_graft, k_keep, k_churn_d, k_churn_u = jax.random.split(state.key, 5)
     t = state.t_ms
@@ -143,6 +151,11 @@ def heartbeat_step(
             nbr_ok = neighbor_pull_bool(
                 alive & state.subscribed, conns, rev, batch_factor)
         valid = has_conn & alive[:, None] & nbr_ok & state.subscribed[:, None]
+    if edge_ok is not None:
+        # fault injection: a partitioned edge is invalid for the round even
+        # though both endpoints are alive; applied after valid_pre too, so
+        # the fault scan can hoist the liveness conjunction and still mask
+        valid = valid & edge_ok
 
     if deg_in is not None:
         # carried-degree protocol: mesh_mask ⊆ valid already (caller's

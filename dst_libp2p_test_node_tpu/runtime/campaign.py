@@ -99,6 +99,12 @@ from ..ops.adversary import (
     heartbeats_to_graylist,
     run_attacked_heartbeats,
 )
+from ..ops.faults import (
+    FaultParams,
+    fault_masks,
+    partition_edge_mask,
+    run_faulted_heartbeats,
+)
 from ..ops.repair import RepairParams, run_recovery_heartbeats
 from .simulator import ExperimentConfig, MessageRecord, Simulator
 from .summarize import sanitize_nonfinite
@@ -127,6 +133,93 @@ def attack_gossipsub(**overrides) -> GossipSubParams:
     return GossipSubParams(**base)
 
 
+@dataclass(frozen=True)
+class SupervisorConfig:
+    """Host-side trial supervision: timeout + bounded retry with exponential
+    backoff + quarantine. The reference tooling "re-runs crashed
+    experiments" (SURVEY §5); this closes that row — one poisoned trial
+    (device OOM, NaN, checkify trip, hung scan) degrades the sweep instead
+    of aborting it, and retries resume from the per-trial checkpoints when
+    `checkpoint_dir` is set, so a re-run pays only the failed cell.
+
+    Retry k (1-based) sleeps retry_backoff_s * 2**(k-1) first, so the total
+    backoff budget for a cell is retry_backoff_s * (2**max_retries - 1).
+
+    `trial_timeout_s` > 0 runs each attempt on a worker thread and abandons
+    it at the deadline. Python cannot cancel in-flight XLA work: the
+    abandoned attempt may still be finishing its device call while the
+    retry starts, which is safe for results (every attempt re-derives all
+    trial state from _reset_trial, and checkpoint writes are atomic
+    tmp->replace with an epoch-hash identity check) but means a truly hung
+    backend still holds its thread. 0 disables the timeout (default).
+
+    `inject_failures`: deterministic failure hook — the first K supervised
+    attempts raise before touching the device. This is the CI/test knob
+    that makes "campaign with K crashes completes degraded" a reproducible
+    assertion, not a hope."""
+
+    trial_timeout_s: float = 0.0
+    max_retries: int = 2
+    retry_backoff_s: float = 0.5
+    inject_failures: int = 0
+
+    def validate(self) -> None:
+        if self.trial_timeout_s < 0.0:
+            raise ValueError("trial_timeout_s must be >= 0 (0 disables)")
+        if self.max_retries < 0:
+            raise ValueError("max_retries must be >= 0")
+        if self.retry_backoff_s < 0.0:
+            raise ValueError("retry_backoff_s must be >= 0")
+        if self.inject_failures < 0:
+            raise ValueError("inject_failures must be >= 0")
+
+
+class _FailureInjector:
+    """Counts down SupervisorConfig.inject_failures across supervised
+    attempts (campaign-global, not per-cell: K injected failures total)."""
+
+    def __init__(self, k: int):
+        self.left = int(k)
+
+    def maybe_fail(self) -> None:
+        if self.left > 0:
+            self.left -= 1
+            raise RuntimeError(
+                "injected trial failure (SupervisorConfig.inject_failures)")
+
+
+def _call_with_timeout(fn, timeout_s: float):
+    if timeout_s <= 0.0:
+        return fn()
+    import concurrent.futures as cf
+
+    ex = cf.ThreadPoolExecutor(max_workers=1)
+    try:
+        return ex.submit(fn).result(timeout=timeout_s)
+    finally:
+        # never join the worker: a hung attempt must not hang the sweep
+        ex.shutdown(wait=False)
+
+
+def _supervise(sup: SupervisorConfig, injector: _FailureInjector, run,
+               on_fail=None, sleep=time.sleep):
+    """Run one trial cell under the supervisor. Returns
+    (result | None, retries_used, last_error | None) — None result means
+    every attempt failed and the caller should quarantine the cell."""
+    last_err = None
+    for attempt in range(sup.max_retries + 1):
+        if attempt > 0:
+            sleep(sup.retry_backoff_s * (2 ** (attempt - 1)))
+        try:
+            injector.maybe_fail()
+            return _call_with_timeout(run, sup.trial_timeout_s), attempt, None
+        except Exception as e:  # noqa: BLE001 — the supervisor IS the handler
+            last_err = e
+            if on_fail is not None:
+                on_fail()
+    return None, sup.max_retries, last_err
+
+
 @dataclass
 class CampaignConfig:
     scenario: str = "sybil_graft_flood"
@@ -150,6 +243,11 @@ class CampaignConfig:
     vmap_trials: bool = True
     # snapshot each trial's post-window state here (runtime/checkpoint.py)
     checkpoint_dir: str | None = None
+    # fault schedule compiled into the attack window (ops/faults.py);
+    # defaults all-off — the window then IS run_attacked_heartbeats
+    faults: FaultParams = field(default_factory=FaultParams)
+    # host-side trial supervision (timeout/retry/backoff/quarantine)
+    supervisor: SupervisorConfig = field(default_factory=SupervisorConfig)
 
     def adversary_params(self) -> AdversaryParams:
         return self.adversary or AdversaryParams(scenario=self.scenario)
@@ -171,6 +269,19 @@ class CampaignConfig:
         if self.recovery_heartbeats < 0:
             raise ValueError("recovery_heartbeats must be >= 0")
         self.repair.validate()
+        self.faults.validate()
+        self.supervisor.validate()
+        if self.faults.crash and (
+                self.faults.crash_window[1] > self.attack_heartbeats):
+            # the restart edge must land inside the window or the cohort
+            # never comes back and reconvergence is unmeasurable by
+            # construction (the partition/spike windows MAY spill past the
+            # window end — a still-open partition composes into the publish
+            # schedule's delivery mask instead)
+            raise ValueError(
+                f"crash_window end {self.faults.crash_window[1]} exceeds "
+                f"attack_heartbeats {self.attack_heartbeats}: the restart "
+                "would never fire")
         if adv.eclipse:
             if self.experiment.gossipsub.flood_publish:
                 # flood_publish sends to EVERY connected peer regardless of
@@ -210,6 +321,14 @@ class TrialResult:
     px_grafts_total: int = 0
     redials_total: int = 0
     recovery_time_ms: float = -1.0
+    # fault-injection observables (ops/faults.py); -1 = family not armed
+    # or never reached the milestone
+    heal_time_ms: float = -1.0           # rounds after heal until the first
+    #                                      cross-cut mesh edge, in sim ms
+    post_churn_reconvergence_hb: int = -1  # rounds after restart until the
+    #                                        cohort's mean degree >= D_low
+    coverage_under_partition: float = -1.0  # honest share on the
+    #                                         publisher's side of the cut
 
     def to_dict(self) -> dict:
         # strict-JSON consumers run allow_nan=False; the shared sanitizer
@@ -224,6 +343,12 @@ class CampaignResult:
     trials: list[TrialResult]
     hb_budget: float
     wall_s: float
+    # supervisor outcome: a degraded sweep completed with retries and/or
+    # quarantined cells instead of raising — strict-JSON consumers see the
+    # full record (which cells are missing and why) in quarantined_trials
+    degraded: bool = False
+    quarantined_trials: list = field(default_factory=list)
+    retries_total: int = 0
 
     @property
     def trials_per_s(self) -> float:
@@ -236,6 +361,9 @@ class CampaignResult:
             "hb_budget": self.hb_budget,
             "wall_s": self.wall_s,
             "trials_per_s": self.trials_per_s,
+            "degraded": self.degraded,
+            "retries_total": self.retries_total,
+            "quarantined_trials": list(self.quarantined_trials),
             "trials": [t.to_dict() for t in self.trials],
         })
 
@@ -260,10 +388,17 @@ def _publish_schedule(
     censor=None,
     attacker=None,
     adv: AdversaryParams | None = None,
+    cross=None,
+    partition_ms=None,
 ) -> list[MessageRecord]:
     """The experiment's injection schedule (Simulator.run's loop), with the
     adversarial delivery mask threaded into every publish and the P3-analog
-    censorship penalty applied after each one."""
+    censorship penalty applied after each one.
+
+    `cross`/`partition_ms`: a still-open partition (ops/faults.py window
+    extending past the attack window) folds its cross-cut edge mask into
+    the delivery mask of every publish falling inside [lo, hi) sim-ms —
+    "eclipse during a partition" is censor|cross on the same publish."""
     exp = sim.cfg
     n = exp.topo.network_size
     delay_ms = exp.topo.delay_seconds * 1000.0
@@ -272,7 +407,12 @@ def _publish_schedule(
     for i in range(exp.topo.messages):
         if i > 0:
             sim.advance(delay_ms)
-        rec = sim.publish(pub, censor_edge=censor)
+        eff = censor
+        if cross is not None and partition_ms is not None:
+            t_now = float(np.asarray(sim.state.t_ms))
+            if partition_ms[0] <= t_now < partition_ms[1]:
+                eff = cross if censor is None else (censor | cross)
+        rec = sim.publish(pub, censor_edge=eff)
         if censor is not None:
             import jax.numpy as jnp
 
@@ -418,17 +558,53 @@ def _pad_to_groups(states: list, attackers: list, trial_mesh):
 
 
 def _attack_windows(sim: Simulator, attackers, states, adv, steps: int,
-                    trial_mesh=None):
+                    trial_mesh=None, faults=None, fmasks=None):
     """Run the attack window for a batch of trials. With `trial_mesh` (a 2-D
     make_trial_mesh grid) the stacked batch shards over the "trials" device
     axis — each group scans its own sub-batch concurrently. Un-sharded
     multi-trial batches stack onto one vmapped scan (the fraction's whole
-    seed column in one device program); single trials run the plain jit."""
+    seed column in one device program); single trials run the plain jit.
+
+    `faults`/`fmasks`: an armed FaultParams plus the per-trial fault_masks
+    cohorts (list of dicts of device arrays) route the window through
+    run_faulted_heartbeats. Fault windows run vmapped, not trial-sharded:
+    the fault scan's frozen-mesh carry and per-trial cohort masks are not
+    plumbed through the shard_map specs yet, so a trial_mesh is ignored
+    here (documented fallback; the recovery windows still shard)."""
     import jax
     import jax.numpy as jnp
 
     tree = jax.tree_util.tree_map
     a = sim.arrays
+    faulted = faults is not None and faults.enabled
+    if faulted and trial_mesh is not None:
+        trial_mesh = None
+    if faulted and len(states) == 1:
+        m = fmasks[0]
+        st, obs = run_faulted_heartbeats(
+            states[0], a["conns"], a["rev"], a["out_mask"], attackers[0],
+            sim.params, adv, faults, m["crash"], m["side"], m["spike"],
+            steps)
+        return [st], [tree(np.asarray, obs)]
+    if faulted:
+        s_count = len(states)
+        stacked = tree(lambda *xs: jnp.stack(xs), *states)
+        att = jnp.stack(attackers)
+        crs = jnp.stack([m["crash"] for m in fmasks])
+        sds = jnp.stack([m["side"] for m in fmasks])
+        sps = jnp.stack([m["spike"] for m in fmasks])
+
+        def one_f(st, at, cr, sd, sp):
+            return run_faulted_heartbeats(
+                st, a["conns"], a["rev"], a["out_mask"], at, sim.params,
+                adv, faults, cr, sd, sp, steps, batch_factor=s_count)
+
+        out_states, obs = jax.vmap(one_f)(stacked, att, crs, sds, sps)
+        obs_np = tree(np.asarray, obs)
+        return (
+            [tree(lambda x, j=j: x[j], out_states) for j in range(s_count)],
+            [{k: v[j] for k, v in obs_np.items()} for j in range(s_count)],
+        )
     if trial_mesh is not None and len(states) > 1:
         from ..ops.state import repair_inert, restore_repair, strip_repair
         from ..parallel.sharding import place_trial_batch
@@ -585,6 +761,14 @@ def _attacked_trials(
         att = attacker_cohort(n, fraction, seed=s, conns=conns_np,
                               publisher=pub, eclipse=adv.eclipse)
         cohorts[s] = (att, jnp.asarray(att))
+    faulted = cfg.faults.enabled
+    fmasks_np: dict[int, dict] = {}
+    fmasks_dev: dict[int, dict] = {}
+    if faulted:
+        for s in seeds:
+            fm = fault_masks(n, cfg.faults, seed=s, publisher=pub)
+            fmasks_np[s] = fm
+            fmasks_dev[s] = {k: jnp.asarray(v) for k, v in fm.items()}
     if cfg.checkpoint_dir:
         for s in seeds:
             got = _try_resume(sim, cfg, fraction, s)
@@ -605,7 +789,9 @@ def _attacked_trials(
     if run_seeds:
         w_states, w_obs = _attack_windows(
             sim, [cohorts[s][1] for s in run_seeds], run_states, adv, steps,
-            trial_mesh=trial_mesh)
+            trial_mesh=trial_mesh,
+            faults=cfg.faults if faulted else None,
+            fmasks=[fmasks_dev[s] for s in run_seeds] if faulted else None)
         for j, s in enumerate(run_seeds):
             state_by_seed[s] = w_states[j]
             obs_by_seed[s] = w_obs[j]
@@ -625,6 +811,15 @@ def _attacked_trials(
         base = _ensure_baseline(sim, cache, s)
         _reset_trial(sim, s)
         sim.state = state_by_seed[s]
+        part_ms = None
+        if cfg.faults.partition:
+            # sim-ms bounds of the partition window, anchored on the
+            # post-window clock (works for resumed trials too): a window
+            # extending past the attack window stays open for the publish
+            # schedule below
+            t_win0 = float(np.asarray(sim.state.t_ms)) - steps * hb_ms
+            pws, pwe = cfg.faults.partition_window
+            part_ms = (t_win0 + pws * hb_ms, t_win0 + pwe * hb_ms)
         if cfg.checkpoint_dir and s not in resumed:
             from .checkpoint import save_checkpoint
 
@@ -661,8 +856,11 @@ def _attacked_trials(
                 sim.rebind_graph(cn2, rv2, om2)
             # concatenate the shared observables: engagement/recovery
             # rounds are counted over the whole attack+recovery timeline
-            obs_j = {k: np.concatenate(
-                [np.asarray(obs_j[k]), np.asarray(robs[k])]) for k in obs_j}
+            # (fault-only curves have no recovery leg — they keep their
+            # attack-window length and indexing)
+            obs_j = {k: (np.concatenate(
+                [np.asarray(obs_j[k]), np.asarray(robs[k])])
+                if k in robs else np.asarray(obs_j[k])) for k in obs_j}
             rec_ok = ((robs["attacker_mesh_share"]
                        <= cfg.mesh_recovery_share)
                       & (robs["pub_honest_degree"] >= 1.0))
@@ -670,10 +868,38 @@ def _attacked_trials(
             if hit.size:
                 recovery_time_ms = float((hit[0] + 1) * hb_ms)
         censor = censor_mask(att_j, sim.arrays["conns"])
+        part_cross = None
+        if part_ms is not None:
+            # cross-cut mask over the CURRENT conns (the repair window may
+            # have extended the graph)
+            part_cross = partition_edge_mask(
+                fmasks_dev[s]["side"], sim.arrays["conns"])
         records = _publish_schedule(sim, censor=censor, attacker=att_j,
-                                    adv=adv)
+                                    adv=adv, cross=part_cross,
+                                    partition_ms=part_ms)
         honest = ~att
         cov, p50, p99 = _delivery_metrics(records, honest)
+        heal_time_ms = -1.0
+        reconv_hb = -1
+        cov_part = -1.0
+        if cfg.faults.partition:
+            pws, pwe = cfg.faults.partition_window
+            curve = np.asarray(obs_j.get("cross_mesh_edges", ()))
+            if curve.size > pwe:
+                hit = np.nonzero(curve[pwe:] > 0)[0]
+                if hit.size:
+                    heal_time_ms = float((hit[0] + 1) * hb_ms)
+            side_np = fmasks_np[s]["side"]
+            same_side = side_np == side_np[pub]
+            cov_part = float((same_side & honest).sum()
+                             / max(int(honest.sum()), 1))
+        if cfg.faults.crash:
+            cwe = cfg.faults.crash_window[1]
+            curve = np.asarray(obs_j.get("restarted_mean_degree", ()))
+            if curve.size > cwe:
+                hit = np.nonzero(curve[cwe:] >= sim.params.d_low)[0]
+                if hit.size:
+                    reconv_hb = int(hit[0] + 1)
         engaged, gf_final, recovery, share_final = _obs_metrics(
             obs_j, cfg.mesh_recovery_share)
         # final honest-side view of attacker edges (post-publish: includes
@@ -702,6 +928,9 @@ def _attacked_trials(
             px_grafts_total=int(np.asarray(sim.state.px_grafts).sum()),
             redials_total=int(np.asarray(sim.state.redials).sum()),
             recovery_time_ms=recovery_time_ms,
+            heal_time_ms=heal_time_ms,
+            post_churn_reconvergence_hb=reconv_hb,
+            coverage_under_partition=cov_part,
         ))
         if cfg.recovery_heartbeats > 0 and not graph_static:
             # restore the epoch graph: the next trial (and _reset_trial's
@@ -734,8 +963,12 @@ def run_campaign(cfg: CampaignConfig, mesh=None,
     t0 = time.time()
     sim = Simulator(cfg.experiment, mesh=mesh)
     budget = heartbeats_to_graylist(adv, sim.params)
-    if (adv.graft_flood or adv.ihave_spam or adv.iwant_spam) and any(
-            f > 0 for f in cfg.fractions) and math.isinf(budget):
+    if ((adv.graft_flood or adv.ihave_spam or adv.iwant_spam)
+            and not adv.identity_rotation
+            and any(f > 0 for f in cfg.fractions) and math.isinf(budget)):
+        # identity_rotation (and slow_peer_mimicry, which never sets these
+        # flags) is exempt: an inf budget there IS the scenario's finding —
+        # the rotation period defeats the accrual — not a config error
         raise ValueError(
             "score defense cannot engage under this config "
             "(heartbeats_to_graylist is inf): raise |slow_peer_penalty_weight|"
@@ -743,24 +976,68 @@ def run_campaign(cfg: CampaignConfig, mesh=None,
             "attack_gossipsub() is the armed default")
     cache: dict[int, dict] = {}
     trials: list[TrialResult] = []
+    sup = cfg.supervisor
+    injector = _FailureInjector(sup.inject_failures)
+    quarantined: list[dict] = []
+    retries_total = 0
+    # a failed attempt may die mid-recovery with a dialed graph bound;
+    # restore the epoch graph before the retry re-resets the trial
+    graph_can_mutate = (cfg.recovery_heartbeats > 0
+                        and (cfg.repair.px or cfg.repair.redial))
+    epoch = dict(sim.arrays) if graph_can_mutate else None
+
+    def _on_fail():
+        if epoch is not None:
+            sim.rebind_graph(epoch["conns"], epoch["rev"], epoch["out_mask"])
+
+    def _cell(f: float, ss: list[int]) -> list[TrialResult]:
+        if f == 0.0:
+            return [_benign_trial(sim, cfg, s, cache, budget) for s in ss]
+        if trial_mesh is not None and cfg.vmap_trials and len(ss) > 1:
+            return _attacked_trials(sim, cfg, f, ss, cache, budget,
+                                    trial_mesh=trial_mesh)
+        if cfg.vmap_trials and len(ss) > 1 and mesh is None:
+            return _attacked_trials(sim, cfg, f, ss, cache, budget)
+        out: list[TrialResult] = []
+        for s in ss:
+            out.extend(_attacked_trials(sim, cfg, f, [s], cache, budget))
+        return out
+
+    def _quarantine(f: float, ss: list[int], err) -> None:
+        quarantined.append({
+            "fraction": f, "seeds": list(ss),
+            "failures": sup.max_retries + 1,
+            "error": repr(err)[:500] if err is not None else "unknown",
+        })
+
     for f in cfg.fractions:
         seeds = list(cfg.seeds)
-        if f == 0.0:
-            for s in seeds:
-                trials.append(_benign_trial(sim, cfg, s, cache, budget))
-        elif trial_mesh is not None and cfg.vmap_trials and len(seeds) > 1:
-            trials.extend(_attacked_trials(sim, cfg, f, seeds, cache, budget,
-                                           trial_mesh=trial_mesh))
-        elif cfg.vmap_trials and len(seeds) > 1 and mesh is None:
-            trials.extend(_attacked_trials(sim, cfg, f, seeds, cache, budget))
-        else:
-            for s in seeds:
-                trials.extend(
-                    _attacked_trials(sim, cfg, f, [s], cache, budget))
+        res, used, err = _supervise(
+            sup, injector, lambda f=f, ss=seeds: _cell(f, ss), _on_fail)
+        retries_total += used
+        if res is not None:
+            trials.extend(res)
+            continue
+        if len(seeds) == 1:
+            _quarantine(f, seeds, err)
+            continue
+        # the batch is poisoned — isolate per seed so siblings survive
+        # (checkpointed seeds resume instead of recomputing their windows)
+        for s in seeds:
+            res1, used1, err1 = _supervise(
+                sup, injector, lambda f=f, s=s: _cell(f, [s]), _on_fail)
+            retries_total += used1
+            if res1 is not None:
+                trials.extend(res1)
+            else:
+                _quarantine(f, [s], err1)
     return CampaignResult(
         scenario=cfg.scenario,
         network_size=sim.params.n,
         trials=trials,
         hb_budget=budget,
         wall_s=time.time() - t0,
+        degraded=bool(quarantined) or retries_total > 0,
+        quarantined_trials=quarantined,
+        retries_total=retries_total,
     )

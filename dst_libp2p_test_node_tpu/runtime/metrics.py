@@ -462,6 +462,32 @@ class CampaignMetrics:
             "sim ms from attack-window end until the publisher regained an "
             "honest mesh edge and attacker mesh share fell under the floor "
             "(-1 = not recovered)", lab)
+        # fault-injection subsystem (ops/faults.py; populated when the
+        # campaign scheduled a fault window — all -1 otherwise, and -1
+        # sentinels are skipped like non-finite values below)
+        self.heal_time = r.gauge(
+            "dst_testnode_attack_heal_time_ms",
+            "sim ms from partition-window end until no cross-cut mesh edge "
+            "remained severed (-1 = never healed inside the schedule)", lab)
+        self.reconvergence = r.gauge(
+            "dst_testnode_attack_post_churn_reconvergence_hb",
+            "heartbeats after the crash window until restarted peers "
+            "regained mean mesh degree >= D_low (-1 = not reconverged)", lab)
+        self.coverage_partition = r.gauge(
+            "dst_testnode_attack_coverage_under_partition",
+            "fraction of honest peers on the publisher's side of the cut "
+            "(the reachable ceiling while partitioned)", lab)
+        self.degraded = r.gauge(
+            "dst_testnode_attack_campaign_degraded",
+            "1 if the supervisor retried or quarantined any trial cell",
+            ("scenario",))
+        self.retries = r.counter(
+            "dst_testnode_attack_trial_retries_total",
+            "supervisor retries consumed across the campaign", ("scenario",))
+        self.quarantined = r.counter(
+            "dst_testnode_attack_trials_quarantined_total",
+            "trial cells abandoned after exhausting the retry budget",
+            ("scenario",))
 
     def fill_from_campaign(self, campaign: dict) -> None:
         """Project a CampaignResult.to_dict onto the series (duck-typed on
@@ -488,6 +514,25 @@ class CampaignMetrics:
                 v = t.get(key)
                 if v is not None and math.isfinite(float(v)):
                     series.set(float(v), labels=labels)
+            # fault gauges: -1 means "fault family not scheduled / never
+            # happened" — a sentinel, not a measurement, so don't export it
+            for series, key in (
+                (self.heal_time, "heal_time_ms"),
+                (self.reconvergence, "post_churn_reconvergence_hb"),
+                (self.coverage_partition, "coverage_under_partition"),
+            ):
+                v = t.get(key)
+                if v is not None and math.isfinite(float(v)) and float(v) >= 0:
+                    series.set(float(v), labels=labels)
+        scen = {"scenario": campaign["scenario"]}
+        self.degraded.set(1.0 if campaign.get("degraded") else 0.0,
+                          labels=scen)
+        retries = int(campaign.get("retries_total", 0) or 0)
+        if retries:
+            self.retries.inc(retries, labels=scen)
+        quarantined = len(campaign.get("quarantined_trials") or ())
+        if quarantined:
+            self.quarantined.inc(quarantined, labels=scen)
 
     def render(self) -> str:
         return self.registry.render()

@@ -198,7 +198,7 @@ def report_campaign(campaign: dict) -> str:
            f"{_cell(campaign.get('hb_budget'))}")
     cols = ("frac \t seed \t attackers \t coverage \t p50_ms \t inflation "
             "\t hb_gray \t recover_hb \t att_score \t evic \t px \t redial "
-            "\t recover_ms")
+            "\t recover_ms \t heal_ms \t reconv_hb \t cov_part")
     out = [hdr, cols]
     for t in campaign["trials"]:
         out.append(" \t ".join([
@@ -214,9 +214,25 @@ def report_campaign(campaign: dict) -> str:
             str(t.get("px_grafts_total", 0)),
             str(t.get("redials_total", 0)),
             _cell(t.get("recovery_time_ms", -1.0), ".1f"),
+            # fault-injection columns (ops/faults.py); -1 = fault family
+            # not scheduled in this trial, same convention as recover_ms
+            _cell(t.get("heal_time_ms", -1.0), ".1f"),
+            str(t.get("post_churn_reconvergence_hb", -1)),
+            _cell(t.get("coverage_under_partition", -1.0), ".3f"),
         ]))
     out.append(
         f"Trials :  {len(campaign['trials'])}  trials/s :  "
         f"{_cell(campaign.get('trials_per_s'), '.3f')}  wall :  "
         f"{_cell(campaign.get('wall_s'), '.2f')} s")
+    quarantined = campaign.get("quarantined_trials") or []
+    if campaign.get("degraded"):
+        out.append(
+            f"DEGRADED :  supervisor retries :  "
+            f"{campaign.get('retries_total', 0)}  quarantined cells :  "
+            f"{len(quarantined)}")
+        for q in quarantined:
+            out.append(
+                f"  quarantined  frac {_cell(q.get('fraction'))}  seeds "
+                f"{q.get('seeds')}  failures {q.get('failures')}  "
+                f"{q.get('error', '')}")
     return "\n".join(out) + "\n"

@@ -57,6 +57,39 @@ def _hermetic_env(monkeypatch):
             monkeypatch.delenv(var, raising=False)
 
 
+# Per-test wall-clock ceiling: CI installs pytest-timeout and passes
+# --timeout, so a hung scan FAILS tier-1 instead of stalling it until the
+# job-level timeout. Containers without the plugin get a SIGALRM fallback
+# with the same contract (main-thread only — it can't interrupt a stuck C
+# extension on a worker thread, which is exactly pytest-timeout's caveat
+# for its signal method too). 0 disables.
+_PER_TEST_TIMEOUT_S = int(os.environ.get("PYTEST_PER_TEST_TIMEOUT_S", "300"))
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_call(item):
+    import signal
+
+    armed = (_PER_TEST_TIMEOUT_S > 0
+             and hasattr(signal, "SIGALRM")
+             and not item.config.pluginmanager.hasplugin("timeout"))
+    if armed:
+        def _expired(signum, frame):
+            raise TimeoutError(
+                f"test exceeded the {_PER_TEST_TIMEOUT_S}s per-test "
+                "ceiling (conftest SIGALRM fallback; install "
+                "pytest-timeout for stack dumps)")
+
+        prev = signal.signal(signal.SIGALRM, _expired)
+        signal.alarm(_PER_TEST_TIMEOUT_S)
+    try:
+        yield
+    finally:
+        if armed:
+            signal.alarm(0)
+            signal.signal(signal.SIGALRM, prev)
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)

@@ -168,6 +168,73 @@ def test_campaign_validation():
             experiment=_exp(slow_peer_penalty_weight=0.0)))
 
 
+def test_slow_peer_mimicry_evades_graylist_but_keeps_mesh():
+    # the mimic pins its slow-penalty so its score rides just ABOVE the
+    # graylist floor: the defense never engages (budget inf is the finding,
+    # not a config error) yet the attacker keeps its mesh footprint
+    adv = AdversaryParams(scenario="slow_peer_mimicry")
+    assert math.isinf(heartbeats_to_graylist(
+        adv, SimParams(n=16, capacity=8, slow_weight=-10.0, slow_decay=0.9,
+                       graylist_threshold=-50.0)))
+    cfg = CampaignConfig(
+        scenario="slow_peer_mimicry", fractions=(0.0, 0.1), seeds=(0,),
+        experiment=_exp(seed=0), attack_heartbeats=12)
+    res = run_campaign(cfg)
+    assert math.isinf(res.hb_budget)
+    t = [t for t in res.trials if t.fraction > 0][0]
+    assert t.hb_to_graylist == -1          # defense never engaged
+    assert t.graylisted_frac_final == 0.0
+    # score pinned at mimic_margin * graylist_threshold each heartbeat;
+    # the publish phase accrues a little real slowness on top, so the
+    # final score sits between the pin and the graylist floor
+    pin = adv.mimic_margin * cfg.experiment.gossipsub.graylist_threshold
+    G = cfg.experiment.gossipsub.graylist_threshold
+    assert G < t.attacker_score_final <= pin + 1e-3
+    # and the cohort keeps roughly its population share of the mesh
+    assert t.attacker_mesh_share_final > 0.03
+
+
+def test_identity_rotation_budget_closed_form():
+    # rotation scrubs the per-edge accruals every period: if the static
+    # budget can't land inside one period the defense NEVER engages
+    params = SimParams(n=16, capacity=8, slow_weight=-10.0, slow_decay=0.9,
+                       graylist_threshold=-50.0)
+    base = heartbeats_to_graylist(AdversaryParams(
+        scenario="sybil_graft_flood", violation_penalty=1.0), params)
+    assert math.isfinite(base)
+    fast = AdversaryParams(scenario="identity_rotation",
+                           violation_penalty=1.0,
+                           rotation_period_hb=int(base) // 2 + 1)
+    assert math.isinf(heartbeats_to_graylist(fast, params))
+    slow = AdversaryParams(scenario="identity_rotation",
+                           violation_penalty=1.0,
+                           rotation_period_hb=int(base) * 3)
+    assert heartbeats_to_graylist(slow, params) == base
+
+
+def test_identity_rotation_defeats_fast_graylist_but_not_slow():
+    # end-to-end: a rotation period under the static budget keeps the whole
+    # cohort un-graylisted; a period well over it lets the defense engage
+    def run(period):
+        cfg = CampaignConfig(
+            scenario="identity_rotation", fractions=(0.1,), seeds=(0,),
+            experiment=_exp(seed=0), attack_heartbeats=14,
+            adversary=AdversaryParams(scenario="identity_rotation",
+                                      rotation_period_hb=period))
+        return run_campaign(cfg)
+
+    res_fast = run(4)
+    assert math.isinf(res_fast.hb_budget)
+    t = res_fast.trials[0]
+    assert t.hb_to_graylist == -1
+    assert t.graylisted_frac_final == 0.0
+    res_slow = run(40)
+    assert math.isfinite(res_slow.hb_budget)
+    t = res_slow.trials[0]
+    assert 0 < t.hb_to_graylist <= res_slow.hb_budget
+    assert t.graylisted_frac_final >= GRAYLIST_ENGAGED_FRAC
+
+
 @pytest.mark.slow
 def test_all_scenarios_run_end_to_end():
     # every scenario through the full campaign path at a shape where the

@@ -157,6 +157,31 @@ def test_bench_tripwire_parses_committed_artifacts(tmp_path):
     assert bench.best_committed_peer_rounds(str(tmp_path)) == 123.0
 
 
+def test_bench_tripwire_is_keyed_per_config(tmp_path):
+    # the r05 15 KB-payload bounded rung is ~2x slower than the light
+    # pre-r05 configs BY DESIGN; the tripwire must compare like with like,
+    # so the heavy config's best is the r05 record, not the global 31.4M
+    # (which would perpetually trip >20% "regressions" on heavy runs)
+    bench = _load_bench()
+    heavy = bench.best_committed_peer_rounds(config_key=bench.BENCH_CONFIG)
+    assert heavy is not None and 10e6 < heavy < 25e6  # the r05 14.08M row
+    light = bench.best_committed_peer_rounds(config_key="pre-r5-light")
+    assert light is not None and light > 25e6  # r01-r04 bucket keeps 31.4M
+    # the live bench emits its key explicitly, and explicit beats derived
+    assert bench.BENCH_CONFIG == "n100000-r300-m3-bounded"
+    assert bench._config_key_of(
+        {"detail": {"bench_config": "custom", "delivery_mode": "bounded",
+                    "n_peers": 1, "rounds": 2, "timed_messages": 3}},
+    ) == "custom"
+    # unknown-key lookups return None instead of falling back to global
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps(
+        {"n": 1, "rc": 0,
+         "tail": '{"metric": "simulated_peer_rounds_per_sec", '
+                 '"value": 9.0, "detail": {"bench_config": "k1"}}'}))
+    assert bench.best_committed_peer_rounds(str(tmp_path), "k1") == 9.0
+    assert bench.best_committed_peer_rounds(str(tmp_path), "k2") is None
+
+
 def test_bench_tripwire_wiring_orders_error_before_exit():
     # the regression artifact must still be a complete strict-JSON line
     # (error field included) BEFORE the nonzero exit — the driver captures

@@ -1,0 +1,280 @@
+"""Fault-injection subsystem (ops/faults.py + the campaign supervisor).
+
+The contracts pinned here are the ISSUE-8 acceptance criteria:
+
+  - faults DISABLED is a pure delegation: `run_faulted_heartbeats` with
+    `FaultParams()` produces bit-identical buffers to
+    `run_attacked_heartbeats` (same jit cache entry by construction).
+  - faults ARMED consume no device PRNG: cohorts are drawn host-side in
+    `fault_masks`, so the armed run's final key equals the un-faulted
+    run's — the key schedule is fault-invariant.
+  - a scheduled partition heals: cross-cut mesh edges drop to 0 during the
+    window (mesh memory frozen, not scrubbed), return after it, and the
+    campaign reports a finite `heal_time_ms` with coverage >= 0.9x benign.
+  - a crashed cohort reconverges through the normal graft path
+    (`post_churn_reconvergence_hb` >= 0) without collapsing delivery.
+  - the supervisor turns K injected trial crashes into a DEGRADED
+    strict-JSON campaign result (bounded retries with exponential backoff,
+    quarantine after the budget) instead of an exception.
+"""
+
+import json
+import math
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from dst_libp2p_test_node_tpu.config.topology import TopoParams
+from dst_libp2p_test_node_tpu.ops.adversary import (
+    AdversaryParams, attacker_cohort, run_attacked_heartbeats,
+)
+from dst_libp2p_test_node_tpu.ops.faults import (
+    FaultParams, fault_masks, partition_edge_mask, run_faulted_heartbeats,
+)
+from dst_libp2p_test_node_tpu.ops.graph import build_connection_graph
+from dst_libp2p_test_node_tpu.ops.state import (
+    SimParams, graph_arrays, init_state,
+)
+from dst_libp2p_test_node_tpu.runtime import campaign as camp
+from dst_libp2p_test_node_tpu.runtime.campaign import (
+    CampaignConfig, SupervisorConfig, attack_gossipsub, run_campaign,
+)
+from dst_libp2p_test_node_tpu.runtime.simulator import ExperimentConfig
+
+
+def _exp(n=64, seed=0, messages=2, **gs):
+    return ExperimentConfig(
+        topo=TopoParams(network_size=n, anchor_stages=2, min_bandwidth=50,
+                        max_bandwidth=150, min_latency=40, max_latency=130,
+                        msg_size_bytes=2000, messages=messages,
+                        delay_seconds=1.0),
+        connect_to=8, gossipsub=attack_gossipsub(**gs), warmup_s=8.0,
+        seed=seed)
+
+
+def _fixture(n=64, connect_to=8, seed=0, **over):
+    g = build_connection_graph(n, connect_to, seed=seed)
+    params = SimParams(n=n, capacity=g.capacity, slow_weight=-10.0,
+                       slow_decay=0.9, graylist_threshold=-50.0, **over)
+    return params, init_state(params, seed=seed), graph_arrays(g)
+
+
+def _run(params, state, a, faults, steps=6, frac=0.25, seed=1):
+    att = jnp.asarray(attacker_cohort(params.n, frac, seed=seed))
+    fm = fault_masks(params.n, faults, seed=seed)
+    return run_faulted_heartbeats(
+        state, a["conns"], a["rev"], a["out_mask"], att, params,
+        AdversaryParams(), faults, jnp.asarray(fm["crash"]),
+        jnp.asarray(fm["side"]), jnp.asarray(fm["spike"]), steps)
+
+
+# ---------------------------------------------------------------- the
+# determinism contract
+
+def test_disabled_faults_are_bit_identical_to_attack_window():
+    import jax
+
+    params, state, a = _fixture()
+    att = jnp.asarray(attacker_cohort(params.n, 0.25, seed=1))
+    plain, obs_p = run_attacked_heartbeats(
+        state, a["conns"], a["rev"], a["out_mask"], att, params,
+        AdversaryParams(), 6)
+    faulted, obs_f = _run(params, state, a, FaultParams())
+    for lp, lf in zip(jax.tree_util.tree_leaves(plain),
+                      jax.tree_util.tree_leaves(faulted)):
+        np.testing.assert_array_equal(np.asarray(lp), np.asarray(lf))
+    assert set(obs_p) == set(obs_f)  # no fault observables leak in
+    for k in obs_p:
+        np.testing.assert_array_equal(np.asarray(obs_p[k]),
+                                      np.asarray(obs_f[k]))
+
+
+def test_armed_faults_consume_no_prng():
+    # the key schedule must be fault-invariant: every cohort is host-drawn
+    params, state, a = _fixture()
+    att = jnp.asarray(attacker_cohort(params.n, 0.25, seed=1))
+    plain, _ = run_attacked_heartbeats(
+        state, a["conns"], a["rev"], a["out_mask"], att, params,
+        AdversaryParams(), 6)
+    armed, _ = _run(params, state, a, FaultParams(
+        crash_frac=0.2, crash_window=(1, 3),
+        partition_frac=0.4, partition_window=(1, 4),
+        spike_frac=0.2, spike_window=(0, 6), spike_ms=500.0))
+    np.testing.assert_array_equal(np.asarray(plain.key),
+                                  np.asarray(armed.key))
+
+
+def test_fault_masks_deterministic_and_shaped():
+    f = FaultParams(crash_frac=0.25, crash_window=(0, 2),
+                    partition_frac=0.5, partition_window=(0, 2))
+    m1 = fault_masks(64, f, seed=3, publisher=7)
+    m2 = fault_masks(64, f, seed=3, publisher=7)
+    for k in ("crash", "side", "spike"):
+        np.testing.assert_array_equal(m1[k], m2[k])
+    assert not m1["crash"][7]              # the publisher never crashes
+    assert m1["crash"].sum() == 16
+    assert m1["side"].sum() == 32          # |A| = round(frac * n)
+    assert not m1["spike"].any()           # disabled family stays empty
+    assert fault_masks(64, f, seed=4)["crash"].sum() == 16  # seed respun
+
+
+def test_partition_edge_mask_marks_cross_edges_only():
+    conns = jnp.asarray([[1, 2, -1], [0, 2, -1], [0, 1, -1]])
+    side = jnp.asarray([True, True, False])
+    m = np.asarray(partition_edge_mask(side, conns))
+    assert m[0].tolist() == [False, True, False]  # 0-2 crosses, pad clear
+    assert m[1].tolist() == [False, True, False]
+    assert m[2].tolist() == [True, True, False]
+
+
+# ---------------------------------------------------------------- fault
+# dynamics at the op level
+
+def test_partition_freezes_mesh_memory_and_heals():
+    params, state, a = _fixture()
+    f = FaultParams(partition_frac=0.5, partition_window=(1, 4))
+    out, obs = _run(params, state, a, f, steps=7)
+    curve = np.asarray(obs["cross_mesh_edges"])
+    assert curve[0] > 0                 # pre-window: cut edges exist
+    assert (curve[1:4] == 0).all()      # window: no cross mesh edge lives
+    assert (curve[4:] > 0).any()        # heal: frozen memory thawed back
+
+
+def test_crashed_cohort_goes_dark_and_reconverges():
+    params, state, a = _fixture()
+    f = FaultParams(crash_frac=0.3, crash_window=(1, 3))
+    out, obs = _run(params, state, a, f, steps=7)
+    deg = np.asarray(obs["restarted_mean_degree"])
+    assert deg[0] > 0.0                 # pre-crash: cohort is meshed
+    assert (deg[1:3] == 0.0).all()      # dark: no mesh degree at all
+    assert deg[-1] > 0.0                # restarted cold, re-grafted
+    assert bool(np.asarray(out.alive).all())  # everyone returned
+
+
+def test_latency_spike_pushes_only_spiked_uplinks():
+    params, state, a = _fixture()
+    base, _ = _run(params, state, a, FaultParams())
+    f = FaultParams(spike_frac=0.3, spike_window=(0, 6), spike_ms=5000.0)
+    spiked, _ = _run(params, state, a, f)
+    mask = fault_masks(params.n, f, seed=1)["spike"]
+    up_b = np.asarray(base.uplink_free_ms)
+    up_s = np.asarray(spiked.uplink_free_ms)
+    assert (up_s[mask] > up_b[mask]).all()
+    np.testing.assert_array_equal(up_s[~mask], up_b[~mask])
+
+
+# ---------------------------------------------------------------- campaign
+# level: the acceptance criteria
+
+def _campaign(**over):
+    kw = dict(scenario="sybil_graft_flood", fractions=(0.0, 0.1),
+              seeds=(0,), experiment=_exp(), attack_heartbeats=8)
+    kw.update(over)
+    return CampaignConfig(**kw)
+
+
+def test_full_partition_heals_to_benign_coverage():
+    res = run_campaign(_campaign(
+        faults=FaultParams(partition_frac=0.5, partition_window=(1, 4))))
+    t = [t for t in res.trials if t.fraction > 0][0]
+    assert math.isfinite(t.heal_time_ms) and t.heal_time_ms > 0.0
+    assert 0.0 < t.coverage_under_partition < 1.0
+    assert t.honest_coverage >= 0.9 * t.benign_coverage
+    # benign (fraction-0) cells never ran the fault window: sentinels
+    t0 = [t for t in res.trials if t.fraction == 0][0]
+    assert t0.heal_time_ms == -1.0
+
+
+def test_crash_campaign_reports_reconvergence():
+    res = run_campaign(_campaign(
+        faults=FaultParams(crash_frac=0.3, crash_window=(1, 4))))
+    t = [t for t in res.trials if t.fraction > 0][0]
+    assert t.post_churn_reconvergence_hb >= 0
+    assert t.honest_coverage >= 0.9 * t.benign_coverage
+
+
+def test_all_fault_families_compose_with_attack():
+    # "eclipse during a partition is one config": every family armed at
+    # once on top of a live adversary cohort, one scan, strict-JSON out
+    res = run_campaign(_campaign(
+        attack_heartbeats=8,
+        faults=FaultParams(crash_frac=0.2, crash_window=(1, 3),
+                           partition_frac=0.3, partition_window=(2, 5),
+                           spike_frac=0.2, spike_window=(0, 8),
+                           spike_ms=500.0)))
+    t = [t for t in res.trials if t.fraction > 0][0]
+    assert t.attackers > 0
+    assert 0.0 <= t.honest_coverage <= 1.0
+    assert t.post_churn_reconvergence_hb >= -1
+    json.dumps(res.to_dict(), allow_nan=False)
+
+
+def test_fault_params_validation():
+    with pytest.raises(ValueError, match="crash_frac"):
+        FaultParams(crash_frac=1.5).validate()
+    with pytest.raises(ValueError, match="partition_window"):
+        FaultParams(partition_window=(3, 1)).validate()
+    with pytest.raises(ValueError, match="spike_ms"):
+        FaultParams(spike_ms=-1.0).validate()
+    # a crash window past the scan end would never restart the cohort
+    with pytest.raises(ValueError, match="attack_heartbeats"):
+        _campaign(faults=FaultParams(
+            crash_frac=0.1, crash_window=(1, 99))).validate()
+    assert not FaultParams().enabled
+    assert not FaultParams(crash_frac=0.5).enabled  # empty window
+
+
+# ---------------------------------------------------------------- the
+# supervisor
+
+def test_supervisor_backoff_is_exponential():
+    sleeps = []
+    sup = SupervisorConfig(max_retries=3, retry_backoff_s=0.5)
+
+    def boom():
+        raise RuntimeError("always fails")
+
+    res, retries, err = camp._supervise(
+        sup, camp._FailureInjector(0), boom, sleep=sleeps.append)
+    assert res is None and retries == 3
+    assert isinstance(err, RuntimeError)
+    assert sleeps == [0.5, 1.0, 2.0]   # retry_backoff_s * 2**(k-1)
+
+
+def test_injected_crash_degrades_campaign_instead_of_raising():
+    res = run_campaign(_campaign(
+        supervisor=SupervisorConfig(max_retries=2, retry_backoff_s=0.0,
+                                    inject_failures=1)))
+    assert res.degraded
+    assert res.retries_total >= 1
+    assert res.quarantined_trials == []
+    assert len(res.trials) == 2        # both cells completed after retry
+    d = res.to_dict()
+    json.dumps(d, allow_nan=False)     # strict JSON, degraded record in
+    assert d["degraded"] is True
+
+
+def test_exhausted_retries_quarantine_the_cell():
+    # more injected failures than the whole sweep's retry budget: the
+    # campaign must complete WITHOUT raising and name the abandoned cell
+    res = run_campaign(_campaign(
+        fractions=(0.1,),
+        supervisor=SupervisorConfig(max_retries=1, retry_backoff_s=0.0,
+                                    inject_failures=10)))
+    assert res.degraded
+    assert res.trials == []
+    assert len(res.quarantined_trials) == 1
+    q = res.quarantined_trials[0]
+    assert q["fraction"] == 0.1 and q["seeds"] == [0]
+    assert q["failures"] == 2          # max_retries + 1 attempts
+    assert "injected trial failure" in q["error"]
+    json.dumps(res.to_dict(), allow_nan=False)
+
+
+def test_supervisor_validation():
+    with pytest.raises(ValueError, match="max_retries"):
+        SupervisorConfig(max_retries=-1).validate()
+    with pytest.raises(ValueError, match="trial_timeout_s"):
+        SupervisorConfig(trial_timeout_s=-1.0).validate()
