@@ -433,9 +433,21 @@ def main() -> None:
     delays = np.stack([np.asarray(r.delay_ms) for r in results])
     ok = delays < 1e30
     coverage = float(ok.mean())
+    # the device grid this host runs campaigns on (config-7's scheme:
+    # trial groups capped at 4, every remaining device widens each
+    # group's peer submesh). Recorded in the artifact so every committed
+    # number names the grid that produced it, and folded into the config
+    # key on multi-device hosts so the tripwire never compares a 1-chip
+    # artifact against an 8-chip run (single-device runs keep the bare
+    # key — committed artifacts predate the suffix)
+    n_dev = jax.device_count()
+    grid_groups = min(n_dev, 4)
+    grid_per_group = n_dev // grid_groups
+    bench_config = (BENCH_CONFIG if n_dev == 1
+                    else f"{BENCH_CONFIG}-d{n_dev}")
     # regression tripwire vs the best committed artifact OF THIS CONFIG
     # (module docstring; _config_key_of keys the committed records)
-    best = best_committed_peer_rounds(config_key=BENCH_CONFIG)
+    best = best_committed_peer_rounds(config_key=bench_config)
     import os as _os
 
     trip_env = _os.environ.get("BENCH_TRIPWIRE", "")
@@ -454,7 +466,15 @@ def main() -> None:
                               if best is not None else None),
         "detail": {
             # explicit workload identity for the per-config tripwire keying
-            "bench_config": BENCH_CONFIG,
+            # (grid-suffixed on multi-device hosts, see above)
+            "bench_config": bench_config,
+            # the campaign device grid on this host: which trials x peers
+            # shape produced (or would have produced) the sharded numbers
+            "device_grid": {
+                "backend_devices": n_dev,
+                "trial_groups": grid_groups,
+                "peers_per_group": grid_per_group,
+            },
             "n_peers": N_PEERS,
             "rounds": rounds,
             "wall_s": round(wall, 3),

@@ -7,10 +7,17 @@
   5. 1M peers, mix-routed (MOUNTSMIX/MIXD=4)  [--all only; ~minutes]
   6. 2k peers, adversarial campaign (sybil graft-flood sweep)
      [--attack / --only 6; never written to BENCH_CONFIGS.json]
-  7. 2k peers, SHARDED adversarial campaign: the fraction x seed grid
-     partitioned over trial groups (parallel/sharding.make_trial_mesh);
+  7. 2k peers x peers_per_group, NESTED-sharded adversarial campaign:
+     the fraction x seed grid partitioned over trial groups AND the peer
+     axis partitioned over each group's device submesh
+     (parallel/sharding.make_trial_mesh over the full grid); the peer
+     count scales with the submesh width, so wider hosts climb the rung;
      single-device hosts fall back to the vmapped stack  [--all only;
      COMMITTED — the ROADMAP "attack ladder entry"]
+  8. Attacked rung toward 1M peers: 2 trial groups x all remaining
+     devices as the peer submesh, peers = ATTACK_RUNG_PEERS or
+     8192 x peers_per_group  [--only 8; never written to
+     BENCH_CONFIGS.json]
 
 Each config prints ONE JSON line: config id, peers, wall seconds,
 peers*rounds/sec, coverage, p50/p99 dissemination latency (ms). Run:
@@ -264,36 +271,22 @@ def config_6():
     return out
 
 
-def config_7():
-    """Committed sharded adversarial sweep (the ROADMAP "1M-peer attack
-    ladder" line's first rung): sybil graft-flood, fractions {0, 0.1} x
-    seeds {0..3}, with the TRIAL axis sharded over the visible devices
-    (runtime/campaign.run_campaign(trial_mesh=...) — each device group runs
-    its slice of the seed column concurrently). Single-device hosts fall
-    back to the vmapped stack: identical numbers (tests/test_trial_sharding
-    pins sharded == vmapped), different wall. Unlike config 6 this row IS
-    part of the committed BENCH_CONFIGS.json ladder; the resilience gates
-    match config 6 and the tracked series is attack_trials_per_s over the
-    two-level-parallel path."""
-    import jax
-
-    from dst_libp2p_test_node_tpu.parallel.sharding import make_trial_mesh
+def _attacked_sweep(config: int, n: int, trial_mesh, seeds, grid: dict,
+                    attack_heartbeats: int = 20):
+    """Shared body of the grid-sharded attack configs (7 and 8): run the
+    sybil sweep on the given grid and emit the row with the grid recorded."""
     from dst_libp2p_test_node_tpu.runtime.campaign import (
         CampaignConfig, attack_gossipsub, run_campaign)
     from dst_libp2p_test_node_tpu.runtime.simulator import ExperimentConfig
 
-    n = 2048
-    groups = min(len(jax.devices()), 4)
-    trial_mesh = (make_trial_mesh(groups, n_devices=groups)
-                  if groups > 1 else None)
     cfg = CampaignConfig(
         scenario="sybil_graft_flood",
         fractions=(0.0, 0.1),
-        seeds=(0, 1, 2, 3),
+        seeds=tuple(seeds),
         experiment=ExperimentConfig(
             topo=_topo(n, 2000), connect_to=10,
             gossipsub=attack_gossipsub(), warmup_s=30.0, seed=0),
-        attack_heartbeats=20,
+        attack_heartbeats=attack_heartbeats,
     )
     res = run_campaign(cfg, trial_mesh=trial_mesh)
     attacked = [t for t in res.trials if t.fraction > 0]
@@ -307,7 +300,7 @@ def config_7():
                  * cfg.experiment.topo.delay_seconds * 1000.0 // hb_ms)
     rounds = per_trial * len(res.trials) + cfg.attack_heartbeats * len(attacked)
     out = {
-        "config": 7,
+        "config": config,
         "peers": n,
         "wall_s": round(res.wall_s, 2),
         "peer_rounds_per_sec": round(n * rounds / max(res.wall_s, 1e-9), 1),
@@ -315,7 +308,7 @@ def config_7():
         "p50_ms": round(p50, 1),
         "p99_ms": round(p99, 1),
         "scenario": res.scenario,
-        "trial_groups": groups,
+        **grid,
         "attack_trials_per_s": round(res.trials_per_s, 4),
         "hb_to_graylist": engaged if math.isfinite(engaged) else None,
         "hb_budget": res.hb_budget,
@@ -324,8 +317,60 @@ def config_7():
     return out
 
 
+def config_7():
+    """Committed sharded adversarial sweep (the ROADMAP "1M-peer attack
+    ladder" line's first rung): sybil graft-flood, fractions {0, 0.1} x
+    seeds {0..3}, on the FULL nested device grid — trial groups capped at
+    4, every remaining device widens each group's peer submesh
+    (runtime/campaign.run_campaign(trial_mesh=...) with both axes live).
+    The peer count scales with the peer submesh: 2048 x peers_per_group,
+    so the committed 4-device row stays 2048 on a 4x1 grid while an
+    8-device host runs 4096 peers on 4x2 — a larger rung at the same
+    per-device row load. Single-device hosts fall back to the vmapped
+    stack: identical numbers (tests/test_trial_sharding pins sharded ==
+    vmapped), different wall. Unlike config 6 this row IS part of the
+    committed BENCH_CONFIGS.json ladder; the resilience gates match
+    config 6 and the tracked series is attack_trials_per_s over the
+    two-level-parallel path."""
+    import jax
+
+    from dst_libp2p_test_node_tpu.parallel.sharding import make_trial_mesh
+
+    n_dev = len(jax.devices())
+    groups = min(n_dev, 4)
+    per_group = max(n_dev // groups, 1)
+    trial_mesh = make_trial_mesh(groups) if n_dev > 1 else None
+    grid = {"trial_groups": groups, "peers_per_group": per_group,
+            "devices": n_dev}
+    return _attacked_sweep(7, 2048 * per_group, trial_mesh, (0, 1, 2, 3),
+                           grid)
+
+
+def config_8():
+    """Nested-grid attacked rung toward the 1M-peer target (--only 8;
+    OPT-IN, never committed): 2 trial groups x every remaining device as
+    each group's peer submesh — the peer-axis-heavy grid shape. The peer
+    count defaults to 8192 x peers_per_group and is overridable via
+    ATTACK_RUNG_PEERS (a real v5e-8 run sets ATTACK_RUNG_PEERS=1048576 on
+    the 2x4 grid; CPU smoke stays tractable at the default). Fewer seeds
+    than config 7 — the rung measures peer-axis scale, not Monte-Carlo
+    width."""
+    import jax
+
+    from dst_libp2p_test_node_tpu.parallel.sharding import make_trial_mesh
+
+    n_dev = len(jax.devices())
+    groups = 2 if n_dev >= 2 else 1
+    per_group = max(n_dev // groups, 1)
+    trial_mesh = make_trial_mesh(groups) if n_dev > 1 else None
+    n = int(os.environ.get("ATTACK_RUNG_PEERS", 0)) or 8192 * per_group
+    grid = {"trial_groups": groups, "peers_per_group": per_group,
+            "devices": n_dev}
+    return _attacked_sweep(8, n, trial_mesh, (0, 1), grid)
+
+
 CONFIGS = {1: config_1, 2: config_2, 3: config_3, 4: config_4, 5: config_5,
-           6: config_6, 7: config_7}
+           6: config_6, 7: config_7, 8: config_8}
 
 ARTIFACT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "BENCH_CONFIGS.json")
@@ -373,7 +418,7 @@ def check_results(results: list[dict], artifact_path: str = ARTIFACT) -> list[st
             if not (want - 0.04 <= cov <= want + 0.02):
                 fail(c, f"coverage {cov} outside derived churn expectation "
                         f"{want:.4f} (+0.02/-0.04)")
-        elif c == 7:
+        elif c in (7, 8):
             # worst-case HONEST coverage under the sybil sweep: censors
             # cannot stop delivery (attackers forward nothing but honest
             # mesh redundancy routes around them), but the floor is looser
@@ -391,7 +436,7 @@ def check_results(results: list[dict], artifact_path: str = ARTIFACT) -> list[st
             fail(c, f"p99 {p99} ms beyond any sane dissemination horizon")
         # attack configs: the tracked throughput series must be live and
         # the defense must engage within the closed-form heartbeat budget
-        if c in (6, 7):
+        if c in (6, 7, 8):
             if not r.get("attack_trials_per_s", 0.0) > 0.0:
                 fail(c, "attack_trials_per_s not positive")
             if r.get("hb_to_graylist") is None:
@@ -399,9 +444,17 @@ def check_results(results: list[dict], artifact_path: str = ARTIFACT) -> list[st
             elif r["hb_to_graylist"] > r["hb_budget"]:
                 fail(c, f"graylist engagement {r['hb_to_graylist']} hb "
                         f"beyond the closed-form budget {r['hb_budget']}")
-        # wall-time regression budget vs the committed artifact
+        # wall-time regression budget vs the committed artifact — only
+        # comparable when the run matches the committed row's scale: a
+        # wider device grid scales the peer count with it (config 7), and
+        # comparing an n=4096 8-device run against the committed n=2048
+        # 4-device row would gate on the wrong baseline
         base = committed.get(c)
-        if base and r["wall_s"] > base["wall_s"] * WALL_BUDGET:
+        comparable = (base is not None
+                      and base.get("peers") == r.get("peers")
+                      and base.get("devices", r.get("devices"))
+                      == r.get("devices"))
+        if comparable and r["wall_s"] > base["wall_s"] * WALL_BUDGET:
             fail(c, f"wall {r['wall_s']} s exceeds budget "
                     f"{base['wall_s']} s x {WALL_BUDGET}")
     return failures
@@ -430,10 +483,10 @@ def main():
         print(f"GATE FAIL: {f}", file=sys.stderr)
     if a.write and not failures:
         with open(a.write, "w") as fh:
-            # the attack config never enters the committed ladder: the
-            # README config table is pinned to the artifact's rows
+            # the opt-in attack configs never enter the committed ladder:
+            # the README config table is pinned to the artifact's rows
             for r in results:
-                if r["config"] != 6:
+                if r["config"] not in (6, 8):
                     fh.write(json.dumps(r, allow_nan=False) + "\n")
     if failures:
         sys.exit(1)

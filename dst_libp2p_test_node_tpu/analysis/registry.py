@@ -30,14 +30,20 @@ The registered surface mirrors the BENCH hot paths exactly:
   kad/find_node           the DHT lookup scan
   multitopic/disseminate  the T*N block-diagonal publish
   campaign/attack_window_sharded
-                          the trial-axis shard_map wrapper around the
-                          vmapped attack window (runtime/campaign.py):
-                          traced on a device-count-adaptive 2-group trial
-                          mesh with the repair leaves STRIPPED, exactly the
-                          program the sharded sweep dispatches (cond census
-                          intentionally unset — the vmapped body trades the
-                          heartbeat conds for select_n, see
+                          the LEGACY trial-only shard_map wrapper around
+                          the vmapped attack window (nested=False): traced
+                          on a device-count-adaptive 2-group trial mesh
+                          with the repair leaves STRIPPED — retained as the
+                          replicated-peer-submesh equality baseline (cond
+                          census intentionally unset — the vmapped body
+                          trades the heartbeat conds for select_n, see
                           run_attacked_heartbeats' note)
+  campaign/attack_window_nested
+                          the nested two-level pjit program the sharded
+                          sweep dispatches by default: explicit
+                          in/out_shardings over the full trials x peers
+                          grid (2 groups x remaining devices per group),
+                          peer rows partitioned inside each trial group
 """
 
 from __future__ import annotations
@@ -168,6 +174,39 @@ def _sharded_attack_spec() -> TraceSpec:
     state, _saved = strip_repair(state)
     groups = 2 if len(jax.devices()) >= 2 else 1
     mesh = make_trial_mesh(groups, n_devices=groups)
+    local = 2
+    trials = groups * local
+    stacked = jax.tree_util.tree_map(
+        lambda x: jnp.stack([jnp.asarray(x)] * trials), state)
+    att = jnp.stack([
+        jnp.asarray(attacker_cohort(params.n, 0.25, seed=s))
+        for s in range(trials)])
+    shared = {k: a[k] for k in ("conns", "rev", "out_mask")}
+    return TraceSpec(
+        fn=sharded_attack_window,
+        args=(stacked, shared, att),
+        kwargs=dict(params=params, adv=AdversaryParams(), steps=3,
+                    trial_mesh=mesh, local_trials=local, nested=False))
+
+
+def _nested_attack_spec() -> TraceSpec:
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.adversary import AdversaryParams, attacker_cohort
+    from ..ops.state import strip_repair
+    from ..parallel.sharding import make_trial_mesh
+    from ..runtime.campaign import sharded_attack_window
+
+    g, params, state, a, _ = _single_topic()
+    state, _saved = strip_repair(state)
+    # the FULL grid: 2 trial groups x every remaining device as each
+    # group's peer submesh (2x2 under the CI lint gate's 4 virtual
+    # devices), degenerating gracefully to 1x1 on a single device — the
+    # contract always traces the nested pjit program the campaign
+    # dispatches, whatever the host's device count
+    groups = 2 if len(jax.devices()) >= 2 else 1
+    mesh = make_trial_mesh(groups)
     local = 2
     trials = groups * local
     stacked = jax.tree_util.tree_map(
@@ -431,11 +470,21 @@ def default_contracts() -> list[EntrypointContract]:
             build=_sharded_attack_spec,
             expected_conds=None,
             feedback=[(_first_out, _state_arg_of)],
-            notes="trial-axis shard_map over the vmapped window, repair "
-                  "leaves stripped (the sharded sweep's exact program); "
-                  "the stacked state must feed back aval-stable across "
-                  "windows, and loop/carry rules catch dead weight the "
-                  "r05 way"),
+            notes="legacy trial-only shard_map (nested=False), repair "
+                  "leaves stripped — the replicated-peer-submesh baseline "
+                  "the nested program is pinned against; the stacked state "
+                  "must feed back aval-stable across windows, and "
+                  "loop/carry rules catch dead weight the r05 way"),
+        EntrypointContract(
+            name="campaign/attack_window_nested",
+            build=_nested_attack_spec,
+            expected_conds=None,
+            feedback=[(_first_out, _state_arg_of)],
+            notes="the nested two-level pjit program the sharded sweep "
+                  "actually dispatches: trials split over groups, peer "
+                  "rows split over each group's submesh via explicit "
+                  "in/out_shardings; same aval-stability and loop/carry "
+                  "bars as the legacy baseline"),
         EntrypointContract(
             name="kad/find_node",
             build=_kad_spec,

@@ -349,10 +349,11 @@ def cmd_attack(argv: list[str]) -> int:
                    help="shard the peer axis over all visible devices "
                    "(peers must divide evenly by the device count)")
     p.add_argument("--trial-groups", type=int, default=None, metavar="N",
-                   help="shard the Monte-Carlo TRIAL axis over N device "
-                   "groups (parallel/sharding.make_trial_mesh; N must "
-                   "divide the device count). Mutually exclusive with "
-                   "--mesh; 0 = one group per visible device")
+                   help="run the campaign on the nested trial x peer grid: "
+                   "N trial groups, every remaining device widening each "
+                   "group's peer submesh (parallel/sharding.make_trial_mesh; "
+                   "N must divide the device count). Mutually exclusive "
+                   "with --mesh; 0 = one group per visible device")
     p.add_argument("--checkpoint-dir", default=None,
                    help="snapshot each trial's post-window state here")
     # mesh-repair subsystem (ops/repair.py): the recovery window + knobs

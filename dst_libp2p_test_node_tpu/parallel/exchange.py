@@ -199,15 +199,21 @@ def converge_recv(
 
 def converge_sharded(
     t0: jnp.ndarray, c: RecvConstants, max_iters: int, mesh: Mesh,
-    g_floor=None,
+    g_floor=None, axis_name: str = PEER_AXIS,
 ):
     """shard_map fixpoint over the peer axis: rows of the constants live on
     their shard; each iteration all-gathers the (N,) time vector over ICI
     and psums one convergence bit. Identical results to converge_recv
     (including the optional frozen `g_floor`, which shards with the rows,
     and the carried-out (inc, converged) pair — inc rows shard like the
-    constants; converged is replicated by the psum)."""
-    rows = P(PEER_AXIS)
+    constants; converged is replicated by the psum).
+
+    `axis_name`: which mesh axis the rows partition over — PEER_AXIS on the
+    1-D simulation mesh, or the peer axis of a nested trials x peers grid
+    (parallel/sharding.make_trial_mesh), where the same body runs inside
+    each trial group's submesh. `mesh` may carry other axes; only
+    `axis_name` is mapped here, so any extra axes replicate."""
+    rows = P(axis_name)
     use_floor = g_floor is not None
     if g_floor is None:
         g_floor = jnp.full_like(t0, INF)
@@ -226,14 +232,14 @@ def converge_sharded(
 
         def body(carry):
             t_l, _, _, it = carry
-            t_all = jax.lax.all_gather(t_l, PEER_AXIS, tiled=True)
+            t_all = jax.lax.all_gather(t_l, axis_name, tiled=True)
             inc = _inc_from(t_all, c_l)
             inc_min = inc.min(axis=-1)
             if use_floor:
                 inc_min = jnp.minimum(inc_min, gf_l)
             t_new = jnp.minimum(t_l, jnp.maximum(inc_min, rx_c))
             changed = jax.lax.psum(
-                jnp.any(t_new < t_l).astype(jnp.int32), PEER_AXIS) > 0
+                jnp.any(t_new < t_l).astype(jnp.int32), axis_name) > 0
             return t_new, inc, changed, it + 1
 
         t_l, inc_l, changed, _ = jax.lax.while_loop(

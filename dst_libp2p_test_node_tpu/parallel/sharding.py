@@ -82,13 +82,15 @@ def make_trial_mesh(trial_groups: int | None = None,
                     platform: str | None = None) -> Mesh:
     """2-D trial x peer device grid for Monte-Carlo campaigns
     (runtime/campaign.py): axis 0 ("trials") partitions the (fraction, seed)
-    sweep into independent device groups, axis 1 ("peers") is each group's
-    peer-axis subset. Trials are embarrassingly parallel, so the default is
-    one device per group (trial_groups = all visible devices) — with >1
-    peers per group the window body, whose specs name only "trials",
-    REPLICATES over the group's peer devices (the 0.4.x shard_map cannot
-    re-shard an inner axis from inside the mapped body), which is correct
-    but buys no extra speed."""
+    sweep into independent device groups, axis 1 ("peers") partitions each
+    group's peer row space. Both axes are live: the nested window programs
+    (campaign.sharded_attack_window and friends) shard stacked trial state
+    as P("trials", "peers") and the shared epoch-graph arrays as P("peers"),
+    so with >1 peers per group each window body runs peer-partitioned under
+    GSPMD instead of replicating the group's submesh. The default is still
+    one device per group (trial_groups = all visible devices) — the right
+    grid when trials outnumber devices; widen the peer axis (fewer groups)
+    when the peer count, not the trial count, is the scale axis."""
     devs = jax.devices(platform)
     if n_devices is not None:
         devs = devs[:n_devices]
@@ -108,16 +110,65 @@ def trial_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P(TRIAL_AXIS))
 
 
-def place_trial_batch(stacked, shared: dict, mesh: Mesh):
-    """Place one stacked trial batch for the sharded campaign window:
-    every leaf of `stacked` (leading axis = trials) shards over the
-    "trials" axis; the `shared` dict (epoch graph arrays, identical for
-    every trial) replicates. Returns (stacked, shared)."""
+def nested_sharding(mesh: Mesh) -> NamedSharding:
+    """Both-axes sharding for stacked peer-major leaves (T, N, ...): trials
+    over the "trials" axis, peer rows over each group's "peers" submesh."""
+    return NamedSharding(mesh, P(TRIAL_AXIS, "peers"))
+
+
+def peer_submesh_sharding(mesh: Mesh) -> NamedSharding:
+    """Peer-row sharding of a trial-invariant (N, ...) array on the 2-D
+    grid: rows split over the "peers" axis, replicated across trial groups
+    (the epoch graph arrays every trial shares)."""
+    return NamedSharding(mesh, P("peers"))
+
+
+def peers_per_group(mesh: Mesh) -> int:
+    """Width of the peer submesh inside each trial group (1 on the
+    degenerate trials-only grid)."""
+    return int(mesh.shape.get("peers", 1))
+
+
+def nested_batch_shardings(tree, mesh: Mesh, n_rows: int):
+    """Sharding pytree for a stacked trial batch (or its eval_shape avals)
+    on the nested grid. Rule, by leaf shape: axis 1 == the peer row count
+    -> P("trials", "peers") (peer-major state, attacker masks, per-trial
+    graph copies); everything else with a leading trial axis -> P("trials")
+    (the per-trial scalar clock, PRNG keys, per-round observables). The
+    rule is a layout choice, not a semantics choice — GSPMD computes the
+    same values under any of these placements."""
+    nested = nested_sharding(mesh)
     rows = trial_sharding(mesh)
-    rep = replicated(mesh)
-    stacked = jax.tree_util.tree_map(
-        lambda x: jax.device_put(x, rows), stacked)
-    shared = {k: jax.device_put(v, rep) for k, v in shared.items()}
+
+    def rule(x):
+        if getattr(x, "ndim", 0) >= 2 and x.shape[1] == n_rows:
+            return nested
+        return rows
+
+    return jax.tree_util.tree_map(rule, tree)
+
+
+def place_trial_batch(stacked, shared: dict, mesh: Mesh,
+                      n_rows: int | None = None):
+    """Place one stacked trial batch for the sharded campaign window.
+
+    With `n_rows` (the peer row count) the placement is NESTED: stacked
+    peer-major leaves shard over both grid axes per nested_batch_shardings
+    and the `shared` dict (epoch graph arrays, identical for every trial)
+    row-shards over each group's peer submesh. Without it — the legacy
+    trial-only layout — stacked leaves shard over "trials" alone and the
+    shared arrays replicate. Returns (stacked, shared)."""
+    if n_rows is None:
+        rows = trial_sharding(mesh)
+        rep = replicated(mesh)
+        stacked = jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, rows), stacked)
+        shared = {k: jax.device_put(v, rep) for k, v in shared.items()}
+        return stacked, shared
+    shardings = nested_batch_shardings(stacked, mesh, n_rows)
+    stacked = jax.tree_util.tree_map(jax.device_put, stacked, shardings)
+    prow = peer_submesh_sharding(mesh)
+    shared = {k: jax.device_put(v, prow) for k, v in shared.items()}
     return stacked, shared
 
 

@@ -72,12 +72,14 @@ instead of restarting the campaign, including across trial-group
 boundaries of a sharded run.
 
 Two-level device parallelism: `run_campaign(trial_mesh=...)` takes a 2-D
-(trials x peers) grid from parallel/sharding.make_trial_mesh and shards the
-STACKED TRIAL BATCH over the "trials" device axis — each group scans its
-own sub-batch of a fraction's seed column concurrently, and the batched
-recovery windows ride the same grid. The alternative `mesh=` (1-D peer
-mesh) shards each trial's peer rows instead and keeps trials sequential;
-the two compose at the device-grid level, not per-run.
+(trials x peers) grid from parallel/sharding.make_trial_mesh and runs the
+STACKED TRIAL BATCH as one nested-sharded program — the trial axis splits
+over the grid's trial groups AND each trial's peer rows split over the
+group's peer submesh (explicit in/out_shardings, GSPMD inserts the
+cross-peer collectives), for attack, fault-armed, and recovery windows
+alike. The alternative `mesh=` (1-D peer mesh) shards each trial's peer
+rows instead and keeps trials sequential; the two compose at the
+device-grid level, not per-run.
 """
 
 from __future__ import annotations
@@ -488,19 +490,82 @@ def _obs_metrics(obs: dict, share_floor: float):
     return engaged, float(gf[-1]), recovery, float(share[-1])
 
 
+def _nested_batch_factor(trial_mesh, local_trials: int) -> int:
+    """Static memory-dispatch hint for the pull row-gather inside a nested
+    window (ops/pull.exceeds_budget): per device the batch is `local_trials`
+    trials x 1/per_group of the row space, so the full-N trace shape
+    over-counts by the peer submesh width. Both gather forms are exact —
+    this only tunes WHICH one large pulls take."""
+    from ..parallel.sharding import peers_per_group
+
+    return max(1, -(-local_trials // peers_per_group(trial_mesh)))
+
+
+def _run_nested_window(body, trial_mesh, n_rows: int, stacked_args: tuple,
+                       shared: dict):
+    """Compile `body(*stacked_args, conns, rev, out_mask)` as ONE program
+    over the full 2-D trials x peers grid: explicit in/out_shardings hand
+    GSPMD the placement — stacked peer-major leaves split over BOTH axes
+    (parallel/sharding.nested_batch_shardings), the epoch graph arrays
+    row-shard over each group's peer submesh — and XLA inserts the
+    cross-peer collectives (all-gathers of the (N,)/(N, C) values the
+    involution pulls read, reductions for the observable scalars). Output
+    shardings come from eval_shape + the same shape rule, so results land
+    nested too and the host-side per-trial unstack reads one group's
+    shards."""
+    import jax
+
+    from ..parallel.sharding import (
+        nested_batch_shardings,
+        peer_submesh_sharding,
+    )
+
+    prow = peer_submesh_sharding(trial_mesh)
+    in_sh = tuple(
+        nested_batch_shardings(a, trial_mesh, n_rows) for a in stacked_args
+    ) + (prow, prow, prow)
+    args = stacked_args + (shared["conns"], shared["rev"], shared["out_mask"])
+    out_sh = nested_batch_shardings(
+        jax.eval_shape(body, *args), trial_mesh, n_rows)
+    return jax.jit(body, in_shardings=in_sh, out_shardings=out_sh)(*args)
+
+
 def sharded_attack_window(stacked, shared: dict, attackers, params, adv,
-                          steps: int, trial_mesh, local_trials: int):
-    """One shard_map program over the "trials" device axis: each trial
-    group runs the vmapped attack window for its local slice of the stacked
-    batch. `stacked` leaves and `attackers` carry a leading trial axis
-    divisible by the mesh's group count; `shared` is the epoch graph dict
-    (replicated into every group). The body names only "trials" in its
-    specs, so it replicates over each group's "peers" submesh
-    (parallel/sharding.make_trial_mesh) — scaling rides the trial axis."""
+                          steps: int, trial_mesh, local_trials: int,
+                          nested: bool = True):
+    """One device program over the 2-D trials x peers grid: the stacked
+    batch's trial axis splits across trial groups AND each trial's peer
+    rows split across the group's peer submesh. `stacked` leaves and
+    `attackers` carry a leading trial axis divisible by the mesh's group
+    count; `shared` is the epoch graph dict (peer-row-sharded within every
+    group).
+
+    `nested=True` (default) is the pjit formulation: explicit
+    in/out_shardings over the full grid, both axes live. `nested=False`
+    retains the PR-5 trial-only shard_map whose body names just "trials"
+    in its specs and therefore REPLICATES each group's peer submesh — the
+    equality baseline the nested program is pinned against
+    (tests/test_trial_sharding.py) and the degenerate-grid fallback's
+    semantics (with 1 peer device per group the two emit the same
+    partitioning)."""
     import jax
     from jax.sharding import PartitionSpec as P
 
     from ..parallel.sharding import TRIAL_AXIS, shard_map
+
+    if nested:
+        bf = _nested_batch_factor(trial_mesh, local_trials)
+
+        def body(st, at, cn, rv, om):
+            def one(s, a):
+                return run_attacked_heartbeats(
+                    s, cn, rv, om, a, params, adv, steps, batch_factor=bf)
+
+            return jax.vmap(one)(st, at)
+
+        n_rows = shared["conns"].shape[0]
+        return _run_nested_window(body, trial_mesh, n_rows,
+                                  (stacked, attackers), shared)
 
     t, r = P(TRIAL_AXIS), P()
 
@@ -517,17 +582,60 @@ def sharded_attack_window(stacked, shared: dict, attackers, params, adv,
     )(stacked, attackers, shared["conns"], shared["rev"], shared["out_mask"])
 
 
+def sharded_faulted_window(stacked, shared: dict, attackers, crash, side,
+                           spike, params, adv, faults, steps: int,
+                           trial_mesh, local_trials: int):
+    """The fault-armed nested window: per-trial crash/side/spike cohort
+    masks are (T, N) peer-major exactly like the attacker masks, so they
+    shard over both grid axes and the fault-scheduled scan
+    (ops/faults.run_faulted_heartbeats) runs peer-partitioned inside each
+    trial group — fault sweeps ride the grid instead of falling back to
+    the vmapped single-device stack."""
+    import jax
+
+    bf = _nested_batch_factor(trial_mesh, local_trials)
+
+    def body(st, at, cr, sd, sp, cn, rv, om):
+        def one(s, a, c2, d2, p2):
+            return run_faulted_heartbeats(
+                s, cn, rv, om, a, params, adv, faults, c2, d2, p2, steps,
+                batch_factor=bf)
+
+        return jax.vmap(one)(st, at, cr, sd, sp)
+
+    n_rows = shared["conns"].shape[0]
+    return _run_nested_window(body, trial_mesh, n_rows,
+                              (stacked, attackers, crash, side, spike),
+                              shared)
+
+
 def sharded_recovery_window(stacked, shared: dict, attackers, rparams,
                             steps: int, publisher: int, trial_mesh,
-                            local_trials: int):
+                            local_trials: int, nested: bool = True):
     """The recovery analog of sharded_attack_window: every trial's repair
     window runs from the shared EPOCH graph (recoveries are independent per
     trial), and each trial's possibly-dialed graph arrays come back with a
-    leading trial axis for the host to rebind per trial."""
+    leading trial axis — nested-sharded like the state — for the host to
+    rebind per trial. Same nested/legacy split as the attack window."""
     import jax
     from jax.sharding import PartitionSpec as P
 
     from ..parallel.sharding import TRIAL_AXIS, shard_map
+
+    if nested:
+        bf = _nested_batch_factor(trial_mesh, local_trials)
+
+        def body(st, at, cn, rv, om):
+            def one(s, a):
+                return run_recovery_heartbeats(
+                    s, cn, rv, om, a, rparams, steps, publisher=publisher,
+                    batch_factor=bf)
+
+            return jax.vmap(one)(st, at)
+
+        n_rows = shared["conns"].shape[0]
+        return _run_nested_window(body, trial_mesh, n_rows,
+                                  (stacked, attackers), shared)
 
     t, r = P(TRIAL_AXIS), P()
 
@@ -544,41 +652,91 @@ def sharded_recovery_window(stacked, shared: dict, attackers, rparams,
     )(stacked, attackers, shared["conns"], shared["rev"], shared["out_mask"])
 
 
-def _pad_to_groups(states: list, attackers: list, trial_mesh):
+def _unstack_trial(tree_fn, stacked_out, j: int):
+    """Slice trial j out of a sharded window's stacked output and NORMALIZE
+    its placement to the default device. A nested-sharded output leaf keeps
+    its peer-axis sharding through the slice; leaving that residue on the
+    state would re-partition every downstream host-driven program (the
+    publish schedule, per-trial checkpoints) under GSPMD — whose tie-breaks
+    (sort-based queue ranks) need not match the single-device program the
+    unsharded path runs. One device_put per leaf restores the exact
+    unsharded placement, which is what the PR-5 equality pins compare
+    against."""
+    import jax
+
+    dev0 = jax.devices()[0]
+    return tree_fn(lambda x: jax.device_put(x[j], dev0), stacked_out)
+
+
+def _pad_to_groups(states: list, attackers: list, trial_mesh, extras=None):
     """Pad a trial batch to a multiple of the trial-group count by repeating
     the last trial (extras are dropped after the window). Returns
-    (states, attackers, local_trials)."""
+    (states, attackers, local_trials), or with `extras` (a parallel
+    per-trial list, e.g. fault-mask dicts) padded alongside:
+    (states, attackers, extras, local_trials)."""
     from ..parallel.sharding import TRIAL_AXIS
 
     groups = trial_mesh.shape[TRIAL_AXIS]
     pad = (-len(states)) % groups
     states = list(states) + [states[-1]] * pad
     attackers = list(attackers) + [attackers[-1]] * pad
+    if extras is not None:
+        extras = list(extras) + [extras[-1]] * pad
+        return states, attackers, extras, len(states) // groups
     return states, attackers, len(states) // groups
 
 
 def _attack_windows(sim: Simulator, attackers, states, adv, steps: int,
                     trial_mesh=None, faults=None, fmasks=None):
     """Run the attack window for a batch of trials. With `trial_mesh` (a 2-D
-    make_trial_mesh grid) the stacked batch shards over the "trials" device
-    axis — each group scans its own sub-batch concurrently. Un-sharded
-    multi-trial batches stack onto one vmapped scan (the fraction's whole
-    seed column in one device program); single trials run the plain jit.
+    make_trial_mesh grid) the stacked batch runs as one nested-sharded
+    program — trials split over the grid's trial groups, each trial's peer
+    rows split over the group's peer submesh. Un-sharded multi-trial
+    batches stack onto one vmapped scan (the fraction's whole seed column
+    in one device program); single trials run the plain jit.
 
     `faults`/`fmasks`: an armed FaultParams plus the per-trial fault_masks
     cohorts (list of dicts of device arrays) route the window through
-    run_faulted_heartbeats. Fault windows run vmapped, not trial-sharded:
-    the fault scan's frozen-mesh carry and per-trial cohort masks are not
-    plumbed through the shard_map specs yet, so a trial_mesh is ignored
-    here (documented fallback; the recovery windows still shard)."""
+    run_faulted_heartbeats. The cohort masks are peer-major (T, N) exactly
+    like the attacker masks, so fault sweeps shard over the same grid
+    (sharded_faulted_window) instead of dropping the trial_mesh."""
     import jax
     import jax.numpy as jnp
 
     tree = jax.tree_util.tree_map
     a = sim.arrays
     faulted = faults is not None and faults.enabled
-    if faulted and trial_mesh is not None:
-        trial_mesh = None
+    if faulted and trial_mesh is not None and len(states) > 1:
+        from ..ops.state import repair_inert, restore_repair, strip_repair
+        from ..parallel.sharding import place_trial_batch
+
+        n_rows = sim.params.n
+        s_count = len(states)
+        states, attackers, fmasks, local = _pad_to_groups(
+            states, attackers, trial_mesh, extras=fmasks)
+        saved = None
+        if repair_inert(sim.params):
+            pairs = [strip_repair(s) for s in states]
+            states, saved = [p[0] for p in pairs], [p[1] for p in pairs]
+        stacked = tree(lambda *xs: jnp.stack(xs), *states)
+        att = jnp.stack(attackers)
+        crs = jnp.stack([m["crash"] for m in fmasks])
+        sds = jnp.stack([m["side"] for m in fmasks])
+        sps = jnp.stack([m["spike"] for m in fmasks])
+        (stacked, att, crs, sds, sps), shared = place_trial_batch(
+            (stacked, att, crs, sds, sps), a, trial_mesh, n_rows=n_rows)
+        out_states, obs = sharded_faulted_window(
+            stacked, shared, att, crs, sds, sps, sim.params, adv, faults,
+            steps, trial_mesh, local)
+        obs_np = tree(np.asarray, obs)
+        outs = []
+        for j in range(s_count):
+            st = _unstack_trial(tree, out_states, j)
+            if saved is not None:
+                st = restore_repair(st, saved[j])
+            outs.append(st)
+        return outs, [{k: v[j] for k, v in obs_np.items()}
+                      for j in range(s_count)]
     if faulted and len(states) == 1:
         m = fmasks[0]
         st, obs = run_faulted_heartbeats(
@@ -621,14 +779,14 @@ def _attack_windows(sim: Simulator, attackers, states, adv, steps: int,
             states, saved = [p[0] for p in pairs], [p[1] for p in pairs]
         stacked = tree(lambda *xs: jnp.stack(xs), *states)
         att = jnp.stack(attackers)
-        (stacked, att), shared = place_trial_batch((stacked, att), a,
-                                                   trial_mesh)
+        (stacked, att), shared = place_trial_batch(
+            (stacked, att), a, trial_mesh, n_rows=sim.params.n)
         out_states, obs = sharded_attack_window(
             stacked, shared, att, sim.params, adv, steps, trial_mesh, local)
         obs_np = tree(np.asarray, obs)
         outs = []
         for j in range(s_count):
-            st = tree(lambda x, j=j: x[j], out_states)
+            st = _unstack_trial(tree, out_states, j)
             if saved is not None:
                 st = restore_repair(st, saved[j])
             outs.append(st)
@@ -717,7 +875,7 @@ def _recovery_windows_sharded(sim: Simulator, cfg: CampaignConfig,
         trial_mesh, local)
     obs_np = tree(np.asarray, obs)
     return [
-        (tree(lambda x, j=j: x[j], outs),
+        (_unstack_trial(tree, outs, j),
          {k: v[j] for k, v in obs_np.items()})
         for j in range(t_count)
     ]
@@ -948,12 +1106,13 @@ def run_campaign(cfg: CampaignConfig, mesh=None,
     the Simulator (row-sharded state + shard_map dissemination); peer-sharded
     runs keep trials sequential so placement stays row-wise.
 
-    `trial_mesh`: optional 2-D parallel/sharding.make_trial_mesh grid over
-    the TRIAL axis — each device group runs its slice of a fraction's seed
-    column concurrently (sharded_attack_window / sharded_recovery_window),
-    replacing the vmapped single-device stack. Mutually exclusive with
-    `mesh`: the trial grid already owns every device, and the window bodies
-    replicate over each group's peer submesh."""
+    `trial_mesh`: optional 2-D parallel/sharding.make_trial_mesh grid —
+    each device group runs its slice of a fraction's seed column
+    concurrently AND partitions each trial's peer rows over its peer
+    submesh (sharded_attack_window / sharded_faulted_window /
+    sharded_recovery_window), replacing the vmapped single-device stack.
+    Mutually exclusive with `mesh`: the trial grid already owns every
+    device, including the peer axis inside each group."""
     if mesh is not None and trial_mesh is not None:
         raise ValueError(
             "pass either mesh (peer-axis sharding) or trial_mesh "

@@ -39,14 +39,16 @@ from dst_libp2p_test_node_tpu.ops.adversary import (
 from dst_libp2p_test_node_tpu.ops.graph import build_connection_graph
 from dst_libp2p_test_node_tpu.ops.heartbeat import run_heartbeats
 from dst_libp2p_test_node_tpu.ops.repair import RepairParams
+from dst_libp2p_test_node_tpu.ops.faults import FaultParams
 from dst_libp2p_test_node_tpu.ops.state import (
     REPAIR_LEAVES, SimParams, graph_arrays, init_state, repair_inert,
+    strip_repair,
 )
 from dst_libp2p_test_node_tpu.parallel.sharding import (
-    TRIAL_AXIS, make_trial_mesh,
+    TRIAL_AXIS, make_trial_mesh, peers_per_group,
 )
 from dst_libp2p_test_node_tpu.runtime.campaign import (
-    CampaignConfig, attack_gossipsub, run_campaign,
+    CampaignConfig, attack_gossipsub, run_campaign, sharded_attack_window,
 )
 from dst_libp2p_test_node_tpu.runtime.simulator import ExperimentConfig
 
@@ -229,6 +231,107 @@ def test_inert_repair_leaves_ride_around_the_scan():
                           armed, 3)
     for k in REPAIR_LEAVES:
         assert getattr(out2, k) is not getattr(state, k)
+
+
+def test_trial_mesh_full_grid_and_edge_cases():
+    # the FULL grid under conftest's 8 virtual devices: trial_groups picks
+    # the first axis and every remaining device becomes each group's peer
+    # submesh — both axes live
+    m = make_trial_mesh(2)
+    assert m.shape == {TRIAL_AXIS: 2, "peers": 4}
+    assert peers_per_group(m) == 4
+    # 1-device degenerate grid: still a real 2-axis mesh (1 x 1), so the
+    # nested window program compiles unchanged on a laptop
+    m1 = make_trial_mesh(1, n_devices=1)
+    assert m1.shape == {TRIAL_AXIS: 1, "peers": 1}
+    assert peers_per_group(m1) == 1
+    # validation: group count must be positive and divide the device count
+    with pytest.raises(ValueError):
+        make_trial_mesh(0, n_devices=4)
+    with pytest.raises(ValueError):
+        make_trial_mesh(3)  # 8 devices, non-divisible full grid
+    with pytest.raises(ValueError):
+        make_trial_mesh(5, n_devices=8)
+
+
+def _stacked_attack_fixture(trials=4, fraction=0.2):
+    params, _, a = _make_op_fixture(
+        slow_weight=-10.0, slow_decay=0.9, graylist_threshold=-50.0,
+        gossip_threshold=-10.0, publish_threshold=-20.0)
+    import jax
+
+    states = [strip_repair(init_state(params, seed=s))[0]
+              for s in range(trials)]
+    stacked = jax.tree_util.tree_map(
+        lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]), *states)
+    att = jnp.stack([
+        jnp.asarray(attacker_cohort(params.n, fraction, seed=s))
+        for s in range(trials)])
+    shared = {k: a[k] for k in ("conns", "rev", "out_mask")}
+    return params, stacked, att, shared
+
+
+@pytest.mark.parametrize("fraction", [0.2, 0.0])
+def test_nested_window_matches_replicated_submesh(fraction):
+    # the tentpole contract at the op level: the nested pjit program
+    # (peer axis partitioned inside each trial group) against the legacy
+    # trial-only shard_map that REPLICATES each group's peer submesh.
+    # State leaves must come back bit-identical — the shard boundary moves
+    # placement, never per-peer numerics; only the observable scalar
+    # REDUCTIONS may reassociate across peer shards (rtol 1e-5). At zero
+    # attackers the attacker-mean reductions sum exact zeros, so even the
+    # observables are bit-equal
+    import jax
+
+    params, stacked, att, shared = _stacked_attack_fixture(fraction=fraction)
+    adv = AdversaryParams(scenario="sybil_graft_flood")
+    mesh = make_trial_mesh(2)  # 2 x 4 under conftest's 8 devices
+    out_n = sharded_attack_window(stacked, shared, att, params, adv, 4,
+                                  trial_mesh=mesh, local_trials=2,
+                                  nested=True)
+    out_r = sharded_attack_window(stacked, shared, att, params, adv, 4,
+                                  trial_mesh=mesh, local_trials=2,
+                                  nested=False)
+    st_n, obs_n = out_n
+    st_r, obs_r = out_r
+    jax.tree_util.tree_map(np.testing.assert_array_equal, st_n, st_r)
+    if fraction == 0.0:
+        jax.tree_util.tree_map(np.testing.assert_array_equal, obs_n, obs_r)
+    else:
+        jax.tree_util.tree_map(
+            lambda x, y: np.testing.assert_allclose(x, y, rtol=1e-5),
+            obs_n, obs_r)
+
+
+@pytest.mark.parametrize("groups", [2, 4])
+def test_nested_campaign_equals_vmapped(groups):
+    # end-to-end over the FULL 8-device grid: 2x4 and 4x2 nested meshes
+    # must reproduce the single-device vmapped sweep trial for trial
+    r_v = run_campaign(_cfg())
+    r_s = run_campaign(_cfg(), trial_mesh=make_trial_mesh(groups))
+    _assert_trials_close(r_v.trials, r_s.trials)
+
+
+_FAULT_FIELDS = ("heal_time_ms", "coverage_under_partition",
+                 "post_churn_reconvergence_hb")
+
+
+def test_faulted_sharded_campaign_equals_vmapped():
+    # the PR-6 regression this PR closes: a faulted sweep used to DROP the
+    # trial mesh and silently fall back to the vmapped stack. Now the
+    # crash/side/spike cohort masks shard with the trial batch and the
+    # fault-armed window runs on the nested grid — same numbers, fault
+    # observables included
+    faults = FaultParams(partition_frac=0.5, partition_window=(1, 4),
+                         crash_frac=0.1, crash_window=(1, 3))
+    r_v = run_campaign(_cfg(faults=faults))
+    r_s = run_campaign(_cfg(faults=faults), trial_mesh=make_trial_mesh(2))
+    _assert_trials_close(r_v.trials, r_s.trials)
+    for tv, ts in zip(r_v.trials, r_s.trials):
+        for k in _FAULT_FIELDS:
+            np.testing.assert_allclose(
+                getattr(tv, k), getattr(ts, k), rtol=1e-5,
+                err_msg=f"{k} diverged at seed {tv.seed}")
 
 
 def test_inert_repair_leaves_stripped_from_attack_window():
