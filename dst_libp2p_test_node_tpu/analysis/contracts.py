@@ -73,4 +73,11 @@ class EntrypointContract:
     feedback: list[tuple[Callable, Callable]] = dataclasses.field(
         default_factory=list)
     runtime_check: Callable[[], None] | None = None
+    # retrace budget (runtime/profiling.py): the number of "Finished tracing
+    # + compiling" events a SECOND call of the representative spec with
+    # same-aval inputs may trigger. 0 — the default, and the value for every
+    # shipped contract — means the second call must be a pure jit-cache hit;
+    # any miss is weak-type/shape drift at the call boundary (the PR 1/PR 3
+    # carry bugs) and fails tier-1 (tests/test_profiling.py).
+    retrace_budget: int = 0
     notes: str = ""

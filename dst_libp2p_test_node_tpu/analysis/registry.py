@@ -29,6 +29,16 @@ The registered surface mirrors the BENCH hot paths exactly:
                           mutated graph
   kad/find_node           the DHT lookup scan
   multitopic/disseminate  the T*N block-diagonal publish
+  telemetry/recorded_heartbeats
+                          the armed flight-recorder scan (ops/telemetry.py):
+                          the heartbeat program plus the per-round channel
+                          reductions riding the obs stack — the 4
+                          steady-state conds must survive the added
+                          instrumentation
+  telemetry/recorded_attack_window
+                          the attack window with the recorder armed via the
+                          static telemetry kwarg — the UNBATCHED form, same
+                          cond census as run_attacked_heartbeats
   campaign/attack_window_sharded
                           the LEGACY trial-only shard_map wrapper around
                           the vmapped attack window (nested=False): traced
@@ -220,6 +230,35 @@ def _nested_attack_spec() -> TraceSpec:
         args=(stacked, shared, att),
         kwargs=dict(params=params, adv=AdversaryParams(), steps=3,
                     trial_mesh=mesh, local_trials=local))
+
+
+def _telemetry_spec() -> TraceSpec:
+    from ..ops.telemetry import TelemetryParams, run_recorded_heartbeats
+
+    # armed score params so tel_graylisted_frac / tel_score_q exercise the
+    # deferred-decay reconstruction against live thresholds
+    g, params, state, a, _ = _single_topic(**_ARMED)
+    return TraceSpec(
+        fn=run_recorded_heartbeats,
+        args=(state, a["conns"], a["rev"], a["out_mask"]),
+        kwargs=dict(params=params, steps=4,
+                    telemetry=TelemetryParams(record=True)))
+
+
+def _telemetry_attack_spec() -> TraceSpec:
+    import jax.numpy as jnp
+
+    from ..ops.adversary import (AdversaryParams, attacker_cohort,
+                                 run_attacked_heartbeats)
+    from ..ops.telemetry import TelemetryParams
+
+    g, params, state, a, _ = _single_topic(**_ARMED)
+    att = jnp.asarray(attacker_cohort(params.n, 0.25, seed=1))
+    return TraceSpec(
+        fn=run_attacked_heartbeats,
+        args=(state, a["conns"], a["rev"], a["out_mask"], att),
+        kwargs=dict(params=params, adv=AdversaryParams(), steps=4,
+                    telemetry=TelemetryParams(record=True)))
 
 
 def _kad_spec() -> TraceSpec:
@@ -470,6 +509,9 @@ def default_contracts() -> list[EntrypointContract]:
             build=_sharded_attack_spec,
             expected_conds=None,
             feedback=[(_first_out, _state_arg_of)],
+            # the wrapper jits a fresh shard_map closure per call — one
+            # compile per window by construction, never more
+            retrace_budget=1,
             notes="legacy trial-only shard_map (nested=False), repair "
                   "leaves stripped — the replicated-peer-submesh baseline "
                   "the nested program is pinned against; the stacked state "
@@ -480,11 +522,31 @@ def default_contracts() -> list[EntrypointContract]:
             build=_nested_attack_spec,
             expected_conds=None,
             feedback=[(_first_out, _state_arg_of)],
+            # explicit in/out_shardings force a fresh jit closure per
+            # window: one compile per call by construction
+            retrace_budget=1,
             notes="the nested two-level pjit program the sharded sweep "
                   "actually dispatches: trials split over groups, peer "
                   "rows split over each group's submesh via explicit "
                   "in/out_shardings; same aval-stability and loop/carry "
                   "bars as the legacy baseline"),
+        EntrypointContract(
+            name="telemetry/recorded_heartbeats",
+            build=_telemetry_spec,
+            expected_conds=4,
+            feedback=[(_first_out, _state_arg_of)],
+            notes="flight recorder armed: the channel reductions ride the "
+                  "obs stack without converting any steady-state skip to "
+                  "select_n; state feeds back aval-stable so windowed "
+                  "recording never recompiles"),
+        EntrypointContract(
+            name="telemetry/recorded_attack_window",
+            build=_telemetry_attack_spec,
+            expected_conds=4,
+            feedback=[(_first_out, _state_arg_of)],
+            notes="attack window with the recorder armed via the static "
+                  "telemetry kwarg — same cond census as the bare window; "
+                  "the tel_* channels are pure reductions"),
         EntrypointContract(
             name="kad/find_node",
             build=_kad_spec,

@@ -28,6 +28,11 @@ Subcommands:
   lint       — graft-audit static certification: AST lint over the python
                surface + jaxpr audit of every registered hot entrypoint
                (analysis/). Strict-JSON report on stdout, exit 0 iff clean.
+  trace      — flight-recorder export (ops/telemetry.py): run a warmup plus
+               a recorded heartbeat window and emit a Chrome-trace/perfetto
+               JSON timeline, a per-round .npz and a CSV of every tel_*
+               channel; --profile-dir additionally captures a jax.profiler
+               trace around the run.
 
 Usage:
   python -m dst_libp2p_test_node_tpu run 1 1000 15000 1 10 50 150 40 130 5 0.0 4 0 4000
@@ -824,6 +829,104 @@ def cmd_lint(argv: list[str]) -> int:
     return 1 if violations else 0
 
 
+def cmd_trace(argv: list[str]) -> int:
+    """Flight-recorder trace export: a self-contained mini-run (warmup
+    untraced, then a recorded window) whose per-heartbeat tel_* curves are
+    written as a perfetto-loadable Chrome-trace JSON plus .npz/CSV sidecars.
+    Strict-JSON summary on stdout, exit 0 on success."""
+    p = argparse.ArgumentParser(prog="trace")
+    p.add_argument("-n", "--network-size", type=int, default=64)
+    p.add_argument("--connect-to", type=int, default=6)
+    p.add_argument("--heartbeats", type=int, default=20,
+                   help="recorded window length in heartbeats")
+    p.add_argument("--warmup-hb", type=int, default=10,
+                   help="untraced mesh-stabilization rounds before recording")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--degree-bins", type=int, default=12)
+    p.add_argument("--out", default="trace_out",
+                   help="output directory for the trace artifacts")
+    p.add_argument("--profile-dir", default=None,
+                   help="also capture a jax.profiler trace into this dir")
+    a = p.parse_args(argv)
+
+    import numpy as np
+
+    from .ops.telemetry import TelemetryParams
+    from .runtime.campaign import attack_gossipsub
+    from .runtime.profiling import chrome_trace, profiler_trace
+    from .runtime.simulator import ExperimentConfig, Simulator
+    from .runtime.summarize import sanitize_nonfinite
+
+    cfg = ExperimentConfig(
+        topo=TopoParams(network_size=a.network_size, anchor_stages=5,
+                        min_bandwidth=50, max_bandwidth=150,
+                        min_latency=40, max_latency=130),
+        connect_to=a.connect_to,
+        # armed score params: the recorder's score quantiles / graylist
+        # fraction measure nothing against the compiled-out default weights
+        gossipsub=attack_gossipsub(),
+        warmup_s=0.0,
+        seed=a.seed,
+    )
+    sim = Simulator(cfg)
+    hb_ms = float(sim.params.heartbeat_ms)
+    tp = TelemetryParams(record=True, degree_bins=a.degree_bins)
+    tp.validate()
+    with profiler_trace(a.profile_dir):
+        sim.advance(a.warmup_hb * hb_ms)      # untraced warmup
+        sim.record_telemetry(tp)
+        t0_ms = float(np.asarray(sim.state.t_ms))
+        sim.advance(a.heartbeats * hb_ms)     # the recorded window
+    tel = sim.last_telemetry
+    if not tel:
+        print("flight recorder produced no rounds "
+              "(heartbeats too small for the heartbeat interval?)",
+              file=sys.stderr)
+        return 1
+
+    os.makedirs(a.out, exist_ok=True)
+    ct = chrome_trace(tel, hb_ms, t0_ms=t0_ms,
+                      name=f"gossipsub n={a.network_size} seed={a.seed}")
+    trace_path = os.path.join(a.out, "trace.perfetto.json")
+    with open(trace_path, "w") as fh:
+        json.dump(sanitize_nonfinite(ct), fh, allow_nan=False)
+    npz_path = os.path.join(a.out, "rounds.npz")
+    with open(npz_path, "wb") as fh:
+        np.savez_compressed(fh, **{k: np.asarray(v) for k, v in tel.items()})
+    # CSV: one row per heartbeat, vector channels expanded per index
+    cols = []
+    for k in sorted(tel):
+        arr = np.asarray(tel[k])
+        if arr.ndim == 1:
+            cols.append((k, arr))
+        else:
+            cols.extend((f"{k}_{j}", arr[:, j]) for j in range(arr.shape[1]))
+    steps = int(cols[0][1].shape[0])
+    csv_path = os.path.join(a.out, "rounds.csv")
+    with open(csv_path, "w") as fh:
+        fh.write("hb," + ",".join(k for k, _ in cols) + "\n")
+        for i in range(steps):
+            fh.write(f"{i}," + ",".join(
+                format(float(v[i]), "g") for _, v in cols) + "\n")
+
+    cov = np.asarray(tel["tel_mesh_coverage"])
+    hits = np.nonzero(cov >= 0.9)[0]
+    summary = {
+        "network_size": a.network_size,
+        "heartbeats": steps,
+        "heartbeat_ms": hb_ms,
+        "channels": sorted(tel),
+        "coverage90_hb": int(hits[0]) + 1 if hits.size else -1,
+        "final_mean_degree": float(np.asarray(tel["tel_mean_degree"])[-1]),
+        "trace_json": trace_path,
+        "rounds_npz": npz_path,
+        "rounds_csv": csv_path,
+        "profile_dir": a.profile_dir,
+    }
+    print(json.dumps(sanitize_nonfinite(summary), indent=2, allow_nan=False))
+    return 0
+
+
 def cmd_summarize(argv: list[str]) -> int:
     p = argparse.ArgumentParser(prog="summarize")
     p.add_argument("path")
@@ -881,6 +984,8 @@ def main(argv: list[str] | None = None) -> int:
         return cmd_regression(rest)
     if cmd == "lint":
         return cmd_lint(rest)
+    if cmd == "trace":
+        return cmd_trace(rest)
     print(f"unknown command: {cmd}\n{__doc__}", file=sys.stderr)
     return 2
 

@@ -511,6 +511,7 @@ def run_attacked_heartbeats(
     adv: AdversaryParams,
     steps: int,
     batch_factor: int = 1,
+    telemetry=None,
 ):
     """lax.scan of [heartbeat_step -> adversary_round] x steps.
 
@@ -524,19 +525,29 @@ def run_attacked_heartbeats(
     Like run_heartbeats, the jit boundary is the inner function: no attack
     behavior touches the mesh-repair leaves, so attack windows with repair
     off (the common campaign case — repair arms only the RECOVERY window)
-    run with the 5 repair leaves stripped from the scan carry."""
+    run with the 5 repair leaves stripped from the scan carry.
+
+    `telemetry`: optional armed ops/telemetry.TelemetryParams — the flight
+    recorder's per-round tel_* channels join the obs dict. None or a
+    disabled params normalizes to None and takes the IDENTICAL python
+    trace path (same jaxpr, same jit cache entry as the pre-recorder
+    engine); armed telemetry consumes no PRNG and writes no state leaf,
+    so the protocol trajectory is bit-identical either way."""
+    if telemetry is not None and not telemetry.enabled:
+        telemetry = None
     if repair_inert(params):
         state, saved = strip_repair(state)
         out, obs = _run_attacked_heartbeats(
             state, conns, rev, out_mask, attacker, params, adv, steps,
-            batch_factor)
+            batch_factor, telemetry)
         return restore_repair(out, saved), obs
     return _run_attacked_heartbeats(
         state, conns, rev, out_mask, attacker, params, adv, steps,
-        batch_factor)
+        batch_factor, telemetry)
 
 
-@partial(jax.jit, static_argnames=("params", "adv", "steps", "batch_factor"))
+@partial(jax.jit, static_argnames=("params", "adv", "steps", "batch_factor",
+                                   "telemetry"))
 def _run_attacked_heartbeats(
     state: SimState,
     conns: jnp.ndarray,
@@ -547,6 +558,7 @@ def _run_attacked_heartbeats(
     adv: AdversaryParams,
     steps: int,
     batch_factor: int = 1,
+    telemetry=None,
 ):
     nbr_ok = None
     if params.churn_down_per_hb == 0.0 and params.churn_up_per_hb == 0.0:
@@ -563,6 +575,11 @@ def _run_attacked_heartbeats(
         s, obs = adversary_round(s, conns, rev, attacker, params, adv,
                                  batch_factor=batch_factor, nbr_ok=nbr_ok,
                                  hb_idx=hb)
+        if telemetry is not None:
+            from .telemetry import telemetry_observables
+
+            obs.update(telemetry_observables(
+                s, conns, rev, params, telemetry, batch_factor=batch_factor))
         return s, obs
 
     return jax.lax.scan(body, state, xs, length=steps)

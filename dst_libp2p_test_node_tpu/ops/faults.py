@@ -183,6 +183,7 @@ def run_faulted_heartbeats(
     spike: jnp.ndarray,
     steps: int,
     batch_factor: int = 1,
+    telemetry=None,
 ):
     """The fault-armed attack window: run_attacked_heartbeats with the
     fault schedule compiled into the scan body. `crash`/`side`/`spike` are
@@ -199,24 +200,31 @@ def run_faulted_heartbeats(
       restarted_mean_degree   (crash) mean mesh degree over the restarting
                               cohort — 0 while dark, the reconvergence
                               signal after restart
+
+    `telemetry`: optional armed ops/telemetry.TelemetryParams — the flight
+    recorder's tel_* channels join the obs dict, same contract as
+    run_attacked_heartbeats (disabled normalizes to None; identical trace).
     """
+    if telemetry is not None and not telemetry.enabled:
+        telemetry = None
     if not faults.enabled:
         return run_attacked_heartbeats(
             state, conns, rev, out_mask, attacker, params, adv, steps,
-            batch_factor)
+            batch_factor, telemetry)
     if repair_inert(params):
         state, saved = strip_repair(state)
         out, obs = _run_faulted_heartbeats(
             state, conns, rev, out_mask, attacker, crash, side, spike,
-            params, adv, faults, steps, batch_factor)
+            params, adv, faults, steps, batch_factor, telemetry)
         return restore_repair(out, saved), obs
     return _run_faulted_heartbeats(
         state, conns, rev, out_mask, attacker, crash, side, spike,
-        params, adv, faults, steps, batch_factor)
+        params, adv, faults, steps, batch_factor, telemetry)
 
 
 @partial(jax.jit,
-         static_argnames=("params", "adv", "faults", "steps", "batch_factor"))
+         static_argnames=("params", "adv", "faults", "steps", "batch_factor",
+                          "telemetry"))
 def _run_faulted_heartbeats(
     state: SimState,
     conns: jnp.ndarray,
@@ -231,6 +239,7 @@ def _run_faulted_heartbeats(
     faults: FaultParams,
     steps: int,
     batch_factor: int = 1,
+    telemetry=None,
 ):
     nbr_ok = None
     if (not faults.crash and params.churn_down_per_hb == 0.0
@@ -325,6 +334,11 @@ def _run_faulted_heartbeats(
             obs["restarted_mean_degree"] = (
                 (s.mesh_mask & crash[:, None]).sum()
                 / f32(jnp.maximum(crash.sum(), 1)))
+        if telemetry is not None:
+            from .telemetry import telemetry_observables
+
+            obs.update(telemetry_observables(
+                s, conns, rev, params, telemetry, batch_factor=batch_factor))
         return ((s, frozen) if faults.partition else s), obs
 
     if faults.partition:

@@ -264,7 +264,8 @@ def repair_round(
 
 
 @partial(jax.jit,
-         static_argnames=("params", "steps", "publisher", "batch_factor"))
+         static_argnames=("params", "steps", "publisher", "batch_factor",
+                          "telemetry"))
 def run_recovery_heartbeats(
     state: SimState,
     conns: jnp.ndarray,
@@ -275,6 +276,7 @@ def run_recovery_heartbeats(
     steps: int,
     publisher: int = 0,
     batch_factor: int = 1,
+    telemetry=None,
 ):
     """The post-attack recovery window: lax.scan of
     [heartbeat_step (evict/px branches armed) -> repair_round] x steps with
@@ -287,7 +289,14 @@ def run_recovery_heartbeats(
     leaves shaped (steps,) — the attack observables (shared with
     adversary_round, so campaign curves concatenate) plus per-round repair
     activity and the publisher's honest mesh degree (the eclipse-recovery
-    signal)."""
+    signal).
+
+    `telemetry`: optional armed ops/telemetry.TelemetryParams — the flight
+    recorder's tel_* channels join the obs dict (disabled normalizes to
+    None before the jit via the campaign caller; a disabled params passed
+    here directly is treated as None so the trace stays identical)."""
+    if telemetry is not None and not telemetry.enabled:
+        telemetry = None
 
     def body(carry, _):
         s, cn, rv, om = carry
@@ -308,6 +317,11 @@ def run_recovery_heartbeats(
         obs["evictions"] = (s.evictions.sum() - ev0).astype(f32)
         obs["px_grafts"] = (s.px_grafts.sum() - px0).astype(f32)
         obs["redials"] = (s.redials.sum() - rd0).astype(f32)
+        if telemetry is not None:
+            from .telemetry import telemetry_observables
+
+            obs.update(telemetry_observables(
+                s, cn, rv, params, telemetry, batch_factor=batch_factor))
         return (s, cn, rv, om), obs
 
     return jax.lax.scan(

@@ -108,6 +108,7 @@ from ..ops.faults import (
     run_faulted_heartbeats,
 )
 from ..ops.repair import RepairParams, run_recovery_heartbeats
+from ..ops.telemetry import TelemetryParams
 from .simulator import ExperimentConfig, MessageRecord, Simulator
 from .summarize import sanitize_nonfinite
 
@@ -250,6 +251,12 @@ class CampaignConfig:
     faults: FaultParams = field(default_factory=FaultParams)
     # host-side trial supervision (timeout/retry/backoff/quarantine)
     supervisor: SupervisorConfig = field(default_factory=SupervisorConfig)
+    # opt-in flight recorder (ops/telemetry.py): record=True adds the tel_*
+    # per-heartbeat channels to every window's obs curves (attack, fault,
+    # recovery — vmapped and nested-sharded alike) and the per-round
+    # milestone columns to TrialResult; the default (record=False) leaves
+    # every window on the exact pre-telemetry program
+    telemetry: TelemetryParams = field(default_factory=TelemetryParams)
 
     def adversary_params(self) -> AdversaryParams:
         return self.adversary or AdversaryParams(scenario=self.scenario)
@@ -273,6 +280,7 @@ class CampaignConfig:
         self.repair.validate()
         self.faults.validate()
         self.supervisor.validate()
+        self.telemetry.validate()
         if self.faults.crash and (
                 self.faults.crash_window[1] > self.attack_heartbeats):
             # the restart edge must land inside the window or the cohort
@@ -331,6 +339,11 @@ class TrialResult:
     #                                        cohort's mean degree >= D_low
     coverage_under_partition: float = -1.0  # honest share on the
     #                                         publisher's side of the cut
+    # flight-recorder curve milestones (ops/telemetry.py); -1 = recorder
+    # off or the curve never crossed inside the recorded windows
+    coverage90_hb: int = -1      # first round with tel_mesh_coverage >= 0.9
+    score_cross_hb: int = -1     # first round the median live score drops
+    #                              below graylist_threshold
 
     def to_dict(self) -> dict:
         # strict-JSON consumers run allow_nan=False; the shared sanitizer
@@ -532,7 +545,7 @@ def _run_nested_window(body, trial_mesh, n_rows: int, stacked_args: tuple,
 
 def sharded_attack_window(stacked, shared: dict, attackers, params, adv,
                           steps: int, trial_mesh, local_trials: int,
-                          nested: bool = True):
+                          nested: bool = True, telemetry=None):
     """One device program over the 2-D trials x peers grid: the stacked
     batch's trial axis splits across trial groups AND each trial's peer
     rows split across the group's peer submesh. `stacked` leaves and
@@ -559,7 +572,8 @@ def sharded_attack_window(stacked, shared: dict, attackers, params, adv,
         def body(st, at, cn, rv, om):
             def one(s, a):
                 return run_attacked_heartbeats(
-                    s, cn, rv, om, a, params, adv, steps, batch_factor=bf)
+                    s, cn, rv, om, a, params, adv, steps, batch_factor=bf,
+                    telemetry=telemetry)
 
             return jax.vmap(one)(st, at)
 
@@ -573,18 +587,22 @@ def sharded_attack_window(stacked, shared: dict, attackers, params, adv,
         def one(s, a):
             return run_attacked_heartbeats(
                 s, cn, rv, om, a, params, adv, steps,
-                batch_factor=local_trials)
+                batch_factor=local_trials, telemetry=telemetry)
 
         return jax.vmap(one)(st, at)
 
-    return shard_map(
+    # jit around the shard_map: eagerly-applied shard_map dispatches the
+    # window primitive-by-primitive (~67 compiles per call measured by
+    # runtime/profiling.count_retraces); under jit the whole window is one
+    # program and a second same-aval call costs one closure rebuild
+    return jax.jit(shard_map(
         group, mesh=trial_mesh, in_specs=(t, t, r, r, r), out_specs=(t, t),
-    )(stacked, attackers, shared["conns"], shared["rev"], shared["out_mask"])
+    ))(stacked, attackers, shared["conns"], shared["rev"], shared["out_mask"])
 
 
 def sharded_faulted_window(stacked, shared: dict, attackers, crash, side,
                            spike, params, adv, faults, steps: int,
-                           trial_mesh, local_trials: int):
+                           trial_mesh, local_trials: int, telemetry=None):
     """The fault-armed nested window: per-trial crash/side/spike cohort
     masks are (T, N) peer-major exactly like the attacker masks, so they
     shard over both grid axes and the fault-scheduled scan
@@ -599,7 +617,7 @@ def sharded_faulted_window(stacked, shared: dict, attackers, crash, side,
         def one(s, a, c2, d2, p2):
             return run_faulted_heartbeats(
                 s, cn, rv, om, a, params, adv, faults, c2, d2, p2, steps,
-                batch_factor=bf)
+                batch_factor=bf, telemetry=telemetry)
 
         return jax.vmap(one)(st, at, cr, sd, sp)
 
@@ -611,7 +629,8 @@ def sharded_faulted_window(stacked, shared: dict, attackers, crash, side,
 
 def sharded_recovery_window(stacked, shared: dict, attackers, rparams,
                             steps: int, publisher: int, trial_mesh,
-                            local_trials: int, nested: bool = True):
+                            local_trials: int, nested: bool = True,
+                            telemetry=None):
     """The recovery analog of sharded_attack_window: every trial's repair
     window runs from the shared EPOCH graph (recoveries are independent per
     trial), and each trial's possibly-dialed graph arrays come back with a
@@ -629,7 +648,7 @@ def sharded_recovery_window(stacked, shared: dict, attackers, rparams,
             def one(s, a):
                 return run_recovery_heartbeats(
                     s, cn, rv, om, a, rparams, steps, publisher=publisher,
-                    batch_factor=bf)
+                    batch_factor=bf, telemetry=telemetry)
 
             return jax.vmap(one)(st, at)
 
@@ -643,13 +662,15 @@ def sharded_recovery_window(stacked, shared: dict, attackers, rparams,
         def one(s, a):
             return run_recovery_heartbeats(
                 s, cn, rv, om, a, rparams, steps, publisher=publisher,
-                batch_factor=local_trials)
+                batch_factor=local_trials, telemetry=telemetry)
 
         return jax.vmap(one)(st, at)
 
-    return shard_map(
+    # jit for the same reason as sharded_attack_window's legacy branch:
+    # one program per window instead of eager per-primitive dispatch
+    return jax.jit(shard_map(
         group, mesh=trial_mesh, in_specs=(t, t, r, r, r), out_specs=(t, t),
-    )(stacked, attackers, shared["conns"], shared["rev"], shared["out_mask"])
+    ))(stacked, attackers, shared["conns"], shared["rev"], shared["out_mask"])
 
 
 def _unstack_trial(tree_fn, stacked_out, j: int):
@@ -687,7 +708,8 @@ def _pad_to_groups(states: list, attackers: list, trial_mesh, extras=None):
 
 
 def _attack_windows(sim: Simulator, attackers, states, adv, steps: int,
-                    trial_mesh=None, faults=None, fmasks=None):
+                    trial_mesh=None, faults=None, fmasks=None,
+                    telemetry=None):
     """Run the attack window for a batch of trials. With `trial_mesh` (a 2-D
     make_trial_mesh grid) the stacked batch runs as one nested-sharded
     program — trials split over the grid's trial groups, each trial's peer
@@ -727,7 +749,7 @@ def _attack_windows(sim: Simulator, attackers, states, adv, steps: int,
             (stacked, att, crs, sds, sps), a, trial_mesh, n_rows=n_rows)
         out_states, obs = sharded_faulted_window(
             stacked, shared, att, crs, sds, sps, sim.params, adv, faults,
-            steps, trial_mesh, local)
+            steps, trial_mesh, local, telemetry=telemetry)
         obs_np = tree(np.asarray, obs)
         outs = []
         for j in range(s_count):
@@ -742,7 +764,7 @@ def _attack_windows(sim: Simulator, attackers, states, adv, steps: int,
         st, obs = run_faulted_heartbeats(
             states[0], a["conns"], a["rev"], a["out_mask"], attackers[0],
             sim.params, adv, faults, m["crash"], m["side"], m["spike"],
-            steps)
+            steps, telemetry=telemetry)
         return [st], [tree(np.asarray, obs)]
     if faulted:
         s_count = len(states)
@@ -755,7 +777,8 @@ def _attack_windows(sim: Simulator, attackers, states, adv, steps: int,
         def one_f(st, at, cr, sd, sp):
             return run_faulted_heartbeats(
                 st, a["conns"], a["rev"], a["out_mask"], at, sim.params,
-                adv, faults, cr, sd, sp, steps, batch_factor=s_count)
+                adv, faults, cr, sd, sp, steps, batch_factor=s_count,
+                telemetry=telemetry)
 
         out_states, obs = jax.vmap(one_f)(stacked, att, crs, sds, sps)
         obs_np = tree(np.asarray, obs)
@@ -782,7 +805,8 @@ def _attack_windows(sim: Simulator, attackers, states, adv, steps: int,
         (stacked, att), shared = place_trial_batch(
             (stacked, att), a, trial_mesh, n_rows=sim.params.n)
         out_states, obs = sharded_attack_window(
-            stacked, shared, att, sim.params, adv, steps, trial_mesh, local)
+            stacked, shared, att, sim.params, adv, steps, trial_mesh, local,
+            telemetry=telemetry)
         obs_np = tree(np.asarray, obs)
         outs = []
         for j in range(s_count):
@@ -795,7 +819,7 @@ def _attack_windows(sim: Simulator, attackers, states, adv, steps: int,
     if len(states) == 1:
         st, obs = run_attacked_heartbeats(
             states[0], a["conns"], a["rev"], a["out_mask"], attackers[0],
-            sim.params, adv, steps)
+            sim.params, adv, steps, telemetry=telemetry)
         return [st], [tree(np.asarray, obs)]
     s_count = len(states)
     stacked = tree(lambda *xs: jnp.stack(xs), *states)
@@ -804,7 +828,7 @@ def _attack_windows(sim: Simulator, attackers, states, adv, steps: int,
     def one(st, at):
         return run_attacked_heartbeats(
             st, a["conns"], a["rev"], a["out_mask"], at, sim.params, adv,
-            steps, batch_factor=s_count)
+            steps, batch_factor=s_count, telemetry=telemetry)
 
     out_states, obs = jax.vmap(one)(stacked, att)
     obs_np = tree(np.asarray, obs)
@@ -856,7 +880,7 @@ def _try_resume(sim: Simulator, cfg: CampaignConfig, fraction: float,
 
 def _recovery_windows_sharded(sim: Simulator, cfg: CampaignConfig,
                               states: list, attackers: list, pub: int,
-                              trial_mesh):
+                              trial_mesh, telemetry=None):
     """Batch every trial's recovery window into one shard_map program over
     the trial groups; returns per-trial ((state, conns, rev, out_mask),
     obs) in input order. Each trial recovers from the shared EPOCH graph,
@@ -872,7 +896,7 @@ def _recovery_windows_sharded(sim: Simulator, cfg: CampaignConfig,
     rparams = cfg.repair.apply(sim.params)
     outs, obs = sharded_recovery_window(
         stacked, sim.arrays, att, rparams, cfg.recovery_heartbeats, pub,
-        trial_mesh, local)
+        trial_mesh, local, telemetry=telemetry)
     obs_np = tree(np.asarray, obs)
     return [
         (_unstack_trial(tree, outs, j),
@@ -909,6 +933,9 @@ def _attacked_trials(
     # a wholesale warm-start invalidation, pure r05-regression-class dead
     # weight here — and the epoch-graph restore are both skipped
     graph_static = not (cfg.repair.px or cfg.repair.redial)
+    # normalize ONCE: a disabled recorder must hand the windows the exact
+    # pre-telemetry static key (None), not a distinct-but-inert params value
+    tel = cfg.telemetry if cfg.telemetry.enabled else None
 
     t0 = time.time()
     cohorts: dict[int, tuple] = {}
@@ -949,7 +976,8 @@ def _attacked_trials(
             sim, [cohorts[s][1] for s in run_seeds], run_states, adv, steps,
             trial_mesh=trial_mesh,
             faults=cfg.faults if faulted else None,
-            fmasks=[fmasks_dev[s] for s in run_seeds] if faulted else None)
+            fmasks=[fmasks_dev[s] for s in run_seeds] if faulted else None,
+            telemetry=tel)
         for j, s in enumerate(run_seeds):
             state_by_seed[s] = w_states[j]
             obs_by_seed[s] = w_obs[j]
@@ -962,7 +990,7 @@ def _attacked_trials(
             and len(seeds) > 1):
         recov = _recovery_windows_sharded(
             sim, cfg, [state_by_seed[s] for s in seeds],
-            [cohorts[s][1] for s in seeds], pub, trial_mesh)
+            [cohorts[s][1] for s in seeds], pub, trial_mesh, telemetry=tel)
     out = []
     for j, s in enumerate(seeds):
         att, att_j = cohorts[s]
@@ -1007,7 +1035,8 @@ def _attacked_trials(
                 a = sim.arrays
                 (st2, cn2, rv2, om2), robs = run_recovery_heartbeats(
                     sim.state, a["conns"], a["rev"], a["out_mask"], att_j,
-                    rparams, cfg.recovery_heartbeats, publisher=pub)
+                    rparams, cfg.recovery_heartbeats, publisher=pub,
+                    telemetry=tel)
             robs = jax.tree_util.tree_map(np.asarray, robs)
             sim.state = st2
             if not graph_static:
@@ -1060,6 +1089,18 @@ def _attacked_trials(
                     reconv_hb = int(hit[0] + 1)
         engaged, gf_final, recovery, share_final = _obs_metrics(
             obs_j, cfg.mesh_recovery_share)
+        # flight-recorder curve milestones over the concatenated
+        # attack+recovery timeline (the tel_* channels ride both windows)
+        cov90_hb = -1
+        score_cross_hb = -1
+        tel_cov = np.asarray(obs_j.get("tel_mesh_coverage", ()))
+        if tel_cov.size:
+            cov90_hb = _first_round(tel_cov, lambda c: c >= 0.9)
+        tel_q = np.asarray(obs_j.get("tel_score_q", ()))
+        if tel_q.size:
+            med = tel_q[:, tel_q.shape[1] // 2]
+            thr = float(sim.params.graylist_threshold)
+            score_cross_hb = _first_round(med, lambda c: c < thr)
         # final honest-side view of attacker edges (post-publish: includes
         # the censorship penalties the window could not see). Read the
         # CURRENT conns — the repair window may have extended the graph.
@@ -1089,6 +1130,8 @@ def _attacked_trials(
             heal_time_ms=heal_time_ms,
             post_churn_reconvergence_hb=reconv_hb,
             coverage_under_partition=cov_part,
+            coverage90_hb=cov90_hb,
+            score_cross_hb=score_cross_hb,
         ))
         if cfg.recovery_heartbeats > 0 and not graph_static:
             # restore the epoch graph: the next trial (and _reset_trial's

@@ -21,6 +21,7 @@ Go RawTracer observing live RPCs (metrics.go:289-464).
 
 from __future__ import annotations
 
+import math
 import threading
 from dataclasses import dataclass, field
 
@@ -32,16 +33,30 @@ DELAY_BUCKETS_MS = (
 
 
 def _fmt_value(v: float) -> str:
+    # non-finite first: int(inf) raises, and the exposition format spells
+    # these three tokens exactly (prometheus text format 0.0.4)
     f = float(v)
+    if math.isinf(f):
+        return "+Inf" if f > 0 else "-Inf"
+    if math.isnan(f):
+        return "NaN"
     if f == int(f) and abs(f) < 1e15:
         return f"{int(f)}.0"
     return repr(f)
 
 
+def _escape_label_value(v: str) -> str:
+    # exposition escapes inside quoted label values: backslash first (the
+    # other two introduce backslashes), then quote and newline
+    return (str(v).replace("\\", "\\\\")
+            .replace('"', '\\"').replace("\n", "\\n"))
+
+
 def _fmt_labels(labels: dict[str, str]) -> str:
     if not labels:
         return ""
-    inner = ",".join(f'{k}="{v}"' for k, v in labels.items())
+    inner = ",".join(
+        f'{k}="{_escape_label_value(v)}"' for k, v in labels.items())
     return "{" + inner + "}"
 
 
@@ -401,6 +416,33 @@ class NodeMetrics:
             float(int(unsub_ev[:, peer_id].sum()) * len(nbrs)))
         self.received_unsubscriptions.set(float(unsub_ev[:, nbrs].sum()))
         self.duplicates.set(float(sum(dup[r] for r in rows)))
+
+    def fill_from_telemetry(self, tel: dict) -> None:
+        """Export the latest flight-recorder window (Simulator.last_telemetry,
+        ops/telemetry.py) as the dst_sim_round_* family: one gauge per tel_*
+        channel, labeled per recorded heartbeat (`hb`) — vector channels
+        (degree histogram bins, score quantiles) get an extra `idx` label.
+        Re-filling with a new window overwrites same-hb samples; a LONGER
+        window extends the series (label sets are the identity)."""
+        import numpy as np
+
+        for key in sorted(tel):
+            if not key.startswith("tel_"):
+                continue
+            arr = np.asarray(tel[key])
+            name = "dst_sim_round_" + key[len("tel_"):]
+            help_ = (f"flight-recorder channel {key} from the latest "
+                     "recorded heartbeat window")
+            if arr.ndim == 1:
+                g = self.registry.gauge(name, help_, ("hb",))
+                for i, v in enumerate(arr):
+                    g.set(float(v), labels={"hb": str(i)})
+            elif arr.ndim == 2:
+                g = self.registry.gauge(name, help_, ("hb", "idx"))
+                for i in range(arr.shape[0]):
+                    for j in range(arr.shape[1]):
+                        g.set(float(arr[i, j]),
+                              labels={"hb": str(i), "idx": str(j)})
 
     def render(self) -> str:
         return self.registry.render()

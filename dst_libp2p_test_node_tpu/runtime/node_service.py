@@ -245,6 +245,11 @@ class NodeService:
                 if peer == view:
                     self.metrics.on_delivery(float(d), chunks=self.sim.cfg.topo.num_frags)
         self.metrics.fill_from_sim(self.sim, view)
+        # flight-recorder window (Simulator.record_telemetry): export the
+        # latest per-heartbeat curves as the dst_sim_round_* family
+        tel = getattr(self.sim, "last_telemetry", None)
+        if tel:
+            self.metrics.fill_from_telemetry(tel)
         with self._lock:
             self._metrics_text = self.metrics.render()
         return n_pub

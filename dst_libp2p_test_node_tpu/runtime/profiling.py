@@ -1,0 +1,278 @@
+"""Host-side profiling harness: XLA cost accounting + retrace counting.
+
+The ROADMAP's exact-mode item needs the microbenchmark-first methodology of
+arXiv:1912.03413 — measure where each compiled program sits on the
+roofline before optimizing it. This module derives that, per registered
+EntrypointContract (analysis/registry.py), from XLA's own compile-time
+analyses:
+
+  entrypoint_cost   FLOPs / HBM bytes / peak-memory estimate via
+                    jit(...).lower(...).compile().cost_analysis() and
+                    .memory_analysis() — version-gated (the analysis
+                    surfaces moved across jax releases; absent fields
+                    come back None, never a crash)
+  count_retraces    a context manager counting jit cache misses (the
+                    "Finished tracing + compiling" log events that
+                    jax_log_compiles exposes) — the PR 1/PR 3 carry bugs
+                    were exactly silent per-iteration retraces
+  measure_retraces  calls a contract's representative spec twice with
+                    same-aval inputs and returns the SECOND call's
+                    retrace count; EntrypointContract.retrace_budget
+                    (default 0) turns any excess into a tier-1 failure
+                    (tests/test_profiling.py)
+  roofline          the strict-JSON per-entrypoint block bench.py merges
+                    into BENCH_r*.json detail: {flops, hbm_bytes,
+                    peak_memory_bytes, retraces, retrace_budget}
+  chrome_trace      flight-recorder curves (ops/telemetry.py) rendered as
+                    Chrome-trace/perfetto JSON — one "X" slice per
+                    heartbeat with the channel values in args, plus "C"
+                    counter tracks for the scalar channels
+  profiler_trace    optional jax.profiler capture around a block (the
+                    `trace` CLI's --profile-dir and bench's
+                    BENCH_PROFILE_DIR use the same mechanism)
+"""
+
+from __future__ import annotations
+
+import logging
+from contextlib import contextmanager
+
+import numpy as np
+
+# the pjit cache-miss log lines. jax 0.4.3x logs "Compiling <fn> with
+# global shapes and types" (jax._src.interpreters.pxla) once per in-memory
+# cache miss; earlier releases logged "Finished tracing + compiling"
+# (jax._src.dispatch). A version emits exactly one of the two per miss, so
+# matching either counts each miss once. Counting log events instead of
+# private cache sizes keeps the counter working through jit-internals
+# refactors. (NOT "Finished tracing + transforming": that fires once per
+# sub-transform and would overcount a single compile.)
+_COMPILE_MARKERS = ("Finished tracing + compiling",
+                    "with global shapes and types")
+
+
+class RetraceCounter:
+    """Mutable counter handed out by count_retraces()."""
+
+    def __init__(self):
+        self.count = 0
+        self.events: list[str] = []
+
+
+class _CountingHandler(logging.Handler):
+    def __init__(self, counter: RetraceCounter):
+        super().__init__(level=logging.DEBUG)
+        self._counter = counter
+
+    def emit(self, record: logging.LogRecord) -> None:
+        try:
+            msg = record.getMessage()
+        except Exception:
+            return
+        if any(m in msg for m in _COMPILE_MARKERS):
+            self._counter.count += 1
+            self._counter.events.append(msg[:200])
+
+
+@contextmanager
+def count_retraces():
+    """Count jit cache misses (trace+compile events) inside the block.
+
+    Flips jax_log_compiles on for the duration so the events are emitted at
+    WARNING, attaches a counting handler to the "jax" logger (every
+    jax._src.* module logger propagates into it), and restores both on
+    exit. Persistent-compile-cache hits still count — they are in-memory
+    cache MISSES (a full retrace happened; only the XLA backend compile was
+    skipped), which is exactly what a retrace budget is about."""
+    import jax
+
+    counter = RetraceCounter()
+    handler = _CountingHandler(counter)
+    jlog = logging.getLogger("jax")
+    prev = bool(getattr(jax.config, "jax_log_compiles", False))
+    jax.config.update("jax_log_compiles", True)
+    jlog.addHandler(handler)
+    try:
+        yield counter
+    finally:
+        jlog.removeHandler(handler)
+        jax.config.update("jax_log_compiles", prev)
+
+
+def _dynamic(x) -> bool:
+    """True when a spec argument is a device-traceable pytree (all leaves
+    arrays): those stay jit parameters; everything else (params dataclasses,
+    ints, None) is closed over as a static constant."""
+    import jax
+
+    leaves = jax.tree_util.tree_leaves(x)
+    return bool(leaves) and all(
+        isinstance(leaf, (jax.Array, np.ndarray)) for leaf in leaves)
+
+
+def lower_spec(spec):
+    """Lower a contract's TraceSpec to an XLA program: dynamic (array)
+    arguments become jit parameters, static arguments are closure
+    constants — the same split every registered entrypoint's own jit
+    makes, so the compiled program is the one production calls run."""
+    import jax
+
+    arg_dyn = [i for i, a in enumerate(spec.args) if _dynamic(a)]
+    kw_dyn = sorted(k for k, v in spec.kwargs.items() if _dynamic(v))
+    dyn_args = tuple(spec.args[i] for i in arg_dyn)
+    dyn_kwargs = {k: spec.kwargs[k] for k in kw_dyn}
+
+    def call(dyn_pos, dyn_kw):
+        full = list(spec.args)
+        for i, v in zip(arg_dyn, dyn_pos):
+            full[i] = v
+        kw = dict(spec.kwargs)
+        kw.update(dyn_kw)
+        return spec.fn(*full, **kw)
+
+    return jax.jit(call).lower(dyn_args, dyn_kwargs)
+
+
+def entrypoint_cost(contract) -> dict:
+    """{flops, hbm_bytes, peak_memory_bytes} for the contract's
+    representative program, from XLA's compile-time analyses. Fields the
+    backend/version does not expose come back None (strict-JSON null)."""
+    compiled = lower_spec(contract.build()).compile()
+    out: dict = {"flops": None, "hbm_bytes": None, "peak_memory_bytes": None}
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        if ca:
+            flops = ca.get("flops")
+            if flops is not None and float(flops) >= 0:
+                out["flops"] = float(flops)
+            hbm = ca.get("bytes accessed")
+            if hbm is not None and float(hbm) >= 0:
+                out["hbm_bytes"] = float(hbm)
+    except Exception:
+        pass
+    try:
+        ma = compiled.memory_analysis()
+        peak = (int(ma.argument_size_in_bytes) + int(ma.output_size_in_bytes)
+                + int(ma.temp_size_in_bytes) - int(ma.alias_size_in_bytes))
+        out["peak_memory_bytes"] = peak
+    except Exception:
+        pass
+    return out
+
+
+def measure_retraces(contract) -> int:
+    """Retrace count of a SECOND same-aval call of the contract's
+    representative spec. The first call (fresh spec from contract.build())
+    warms every jit cache on the path; the second builds the spec again —
+    same shapes, same statics — and must hit every cache, so any count
+    above contract.retrace_budget is aval drift at a call boundary."""
+    import jax
+
+    warm = contract.build()
+    jax.block_until_ready(warm.thunk()())
+    spec = contract.build()
+    with count_retraces() as counter:
+        jax.block_until_ready(spec.thunk()())
+    return counter.count
+
+
+def roofline(contracts=None, with_retraces: bool = True) -> dict:
+    """The per-entrypoint roofline block: contract name -> {flops,
+    hbm_bytes, peak_memory_bytes, retraces, retrace_budget} (strict-JSON
+    safe; a contract that cannot lower on this backend reports an `error`
+    string instead of crashing the caller — bench must keep emitting)."""
+    if contracts is None:
+        from ..analysis.registry import default_contracts
+
+        contracts = default_contracts()
+    block: dict = {}
+    for c in contracts:
+        entry: dict = {}
+        try:
+            entry.update(entrypoint_cost(c))
+        except Exception as e:  # noqa: BLE001 — per-entry degradation
+            entry["error"] = repr(e)[:200]
+        if with_retraces and "error" not in entry:
+            try:
+                entry["retraces"] = measure_retraces(c)
+                entry["retrace_budget"] = int(c.retrace_budget)
+            except Exception as e:  # noqa: BLE001
+                entry["error"] = repr(e)[:200]
+        block[c.name] = entry
+    return block
+
+
+def check_retrace_budgets(contracts=None) -> list[dict]:
+    """[{name, retraces, budget}] for every contract whose second call
+    retraces above its declared budget (empty = all clean). The tier-1
+    gate (tests/test_profiling.py) asserts this is empty."""
+    if contracts is None:
+        from ..analysis.registry import default_contracts
+
+        contracts = default_contracts()
+    bad = []
+    for c in contracts:
+        got = measure_retraces(c)
+        if got > c.retrace_budget:
+            bad.append({"name": c.name, "retraces": got,
+                        "budget": int(c.retrace_budget)})
+    return bad
+
+
+@contextmanager
+def profiler_trace(log_dir: str | None):
+    """jax.profiler capture around the block when `log_dir` is set; a
+    plain passthrough otherwise (and when the profiler is unavailable,
+    e.g. a stripped jax build)."""
+    if not log_dir:
+        yield
+        return
+    try:
+        import jax.profiler
+        ctx = jax.profiler.trace(log_dir)
+    except Exception:
+        yield
+        return
+    with ctx:
+        yield
+
+
+# ------------------------------------------------------- trace export
+
+
+def chrome_trace(curves: dict, heartbeat_ms: float, t0_ms: float = 0.0,
+                 pid: int = 0, name: str = "trial") -> dict:
+    """Render flight-recorder curves as Chrome-trace JSON (perfetto loads
+    it directly). One "X" (complete) slice per heartbeat carries every
+    channel value in args; scalar channels additionally get "C" counter
+    tracks so perfetto draws them as time series. `ts`/`dur` are
+    microseconds per the trace-event spec; sim time is milliseconds."""
+    curves = {k: np.asarray(v) for k, v in curves.items()}
+    steps = min((c.shape[0] for c in curves.values()), default=0)
+    events: list[dict] = [
+        {"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+         "args": {"name": name}},
+        {"name": "thread_name", "ph": "M", "pid": pid, "tid": 0,
+         "args": {"name": "heartbeats"}},
+    ]
+    for i in range(steps):
+        ts = (t0_ms + i * heartbeat_ms) * 1000.0
+        args = {}
+        for k, c in curves.items():
+            v = c[i]
+            args[k] = (float(v) if np.ndim(v) == 0
+                       else [float(x) for x in np.ravel(v)])
+        events.append({
+            "name": "heartbeat", "ph": "X", "ts": ts,
+            "dur": heartbeat_ms * 1000.0, "pid": pid, "tid": 0,
+            "args": {"hb": i, **args},
+        })
+        for k, c in curves.items():
+            if np.ndim(c[i]) == 0:
+                events.append({
+                    "name": k, "ph": "C", "ts": ts, "pid": pid,
+                    "args": {"value": float(c[i])},
+                })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}

@@ -287,6 +287,13 @@ class Simulator:
         self._last_msg_id = -1  # go-mode monotonic timestamp tie-break
         self._hb_carry_ms = 0.0
         self.records: list[MessageRecord] = []
+        # flight recorder (ops/telemetry.py): disarmed by default — advance()
+        # then runs the exact pre-telemetry heartbeat program. Armed via
+        # record_telemetry(); last_telemetry holds the most recent window's
+        # host-side tel_* curves (node_service exports them as the
+        # dst_sim_round_* family)
+        self._telemetry = None
+        self.last_telemetry: dict = {}
         self.mix_params = None
         if cfg.uses_mix:
             from ..ops.mix import MixParams
@@ -334,6 +341,7 @@ class Simulator:
         self._last_msg_id = -1
         self._hb_carry_ms = 0.0
         self.records = []
+        self.last_telemetry = {}  # the recorder stays armed across resets
         if not self._churny:
             self._valid_edge = self._compute_valid_edge()
 
@@ -423,15 +431,36 @@ class Simulator:
         if not self._churny:
             self._valid_edge = self._compute_valid_edge()
 
+    def record_telemetry(self, params=None) -> None:
+        """Arm the flight recorder: subsequent advance() calls return their
+        per-heartbeat tel_* curves in `last_telemetry` (host numpy). Pass
+        None or a record=False TelemetryParams to disarm — the disarmed
+        advance() literally delegates to the untraced runner, so arming
+        and disarming never perturbs the benign trajectory."""
+        if params is not None:
+            params.validate()
+            if not params.enabled:
+                params = None
+        self._telemetry = params
+
     def advance(self, ms: float) -> None:
         """Advance simulated time by `ms`, running the heartbeats due."""
         steps, self._hb_carry_ms = drain_heartbeat_carry(
             self._hb_carry_ms, ms, self.params.heartbeat_ms)
         if steps > 0:
             a = self.arrays
-            self.state = run_heartbeats(
-                self.state, a["conns"], a["rev"], a["out_mask"], self.params, steps
-            )
+            if self._telemetry is not None:
+                from ..ops.telemetry import run_recorded_heartbeats
+
+                self.state, trace = run_recorded_heartbeats(
+                    self.state, a["conns"], a["rev"], a["out_mask"],
+                    self.params, steps, telemetry=self._telemetry)
+                self.last_telemetry = {
+                    k: np.asarray(v) for k, v in trace.items()}
+            else:
+                self.state = run_heartbeats(
+                    self.state, a["conns"], a["rev"], a["out_mask"],
+                    self.params, steps)
 
     def warmup(self) -> None:
         self.advance(self.cfg.warmup_s * 1000.0)

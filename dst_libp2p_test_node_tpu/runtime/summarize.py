@@ -198,7 +198,8 @@ def report_campaign(campaign: dict) -> str:
            f"{_cell(campaign.get('hb_budget'))}")
     cols = ("frac \t seed \t attackers \t coverage \t p50_ms \t inflation "
             "\t hb_gray \t recover_hb \t att_score \t evic \t px \t redial "
-            "\t recover_ms \t heal_ms \t reconv_hb \t cov_part")
+            "\t recover_ms \t heal_ms \t reconv_hb \t cov_part \t cov90_hb "
+            "\t score_x_hb")
     out = [hdr, cols]
     for t in campaign["trials"]:
         out.append(" \t ".join([
@@ -219,6 +220,10 @@ def report_campaign(campaign: dict) -> str:
             _cell(t.get("heal_time_ms", -1.0), ".1f"),
             str(t.get("post_churn_reconvergence_hb", -1)),
             _cell(t.get("coverage_under_partition", -1.0), ".3f"),
+            # flight-recorder curve milestones (ops/telemetry.py); -1 =
+            # recorder off or the curve never crossed inside the windows
+            str(t.get("coverage90_hb", -1)),
+            str(t.get("score_cross_hb", -1)),
         ]))
     out.append(
         f"Trials :  {len(campaign['trials'])}  trials/s :  "
