@@ -57,11 +57,41 @@ MESSAGES = 3             # timed dissemination fixpoints (one per ~100 rounds)
 # tripwire fires (module docstring "Regression tripwire")
 REGRESSION_TOLERANCE = 0.20
 
+# the timed loop's delivery mode. EXACT is the model of record and — since
+# the parallel-prefix answer-queue engine — also the default bench mode; the
+# bounded mode stays measured as a probe (publish_bounded_s). The mode rides
+# the config key, so the tripwire never compares an exact-mode run against a
+# committed bounded artifact (or vice versa): flipping the default opens a
+# fresh comparison bucket instead of tripping a false regression.
+DELIVERY_MODE = "exact"
+
 # the workload identity this bench run measures: the tripwire only compares
 # against committed artifacts of the SAME config, so a heavier rung (the r05
 # 15 KB-payload bounded run) neither masks nor falsely trips a regression
-# against the light pre-r05 configs
-BENCH_CONFIG = f"n{N_PEERS}-r{HB_ROUNDS}-m{MESSAGES}-bounded"
+# against the light pre-r05 configs, and a mode flip (bounded -> exact)
+# starts a fresh bucket
+BENCH_CONFIG = f"n{N_PEERS}-r{HB_ROUNDS}-m{MESSAGES}-{DELIVERY_MODE}"
+
+
+def attribution_split(
+    wall_s: float, hb_sync_s: float, dis_sync_s: float,
+) -> tuple[float, float]:
+    """Disjoint per-phase attribution of the metric-of-record wall.
+
+    The instrumented pass that produces hb_sync_s/dis_sync_s syncs after
+    every phase, which removes the dispatch overlap the timed loop enjoys —
+    so the raw synced times can legitimately sum ABOVE the overlapped wall
+    (the r05 artifact shipped disseminate_s 2.322 > wall_s 2.131 this way,
+    which read as an accounting bug). This helper scales the synced SHARES
+    onto the real wall instead: the returned components are disjoint by
+    construction (they sum to wall_s exactly, so the
+    `hb_s + disseminate_s <= wall_s` sanity gate in tests/test_bench_gates
+    holds), and the raw synced values ship alongside as *_sync_s for anyone
+    who wants the overlap-free numbers."""
+    total = hb_sync_s + dis_sync_s
+    if total <= 0.0:
+        return 0.0, 0.0
+    return wall_s * hb_sync_s / total, wall_s * dis_sync_s / total
 
 
 def _config_key_of(rec: dict) -> str:
@@ -141,16 +171,15 @@ def main() -> None:
         )
     )
     graph = build_connection_graph(N_PEERS, 10, seed=0)
-    # Throughput is measured in the BOUNDED delivery mode, the mode the
-    # 100k/1M ladder configs run (accounting/attribution carry the exact
-    # serialized answer queues; arrival times keep the unserialized value
-    # in the cases where a queued answer would deliver first, with the max
-    # queue wait exported as the error bar — see SimParams.serialize_answers
-    # and README "Delivery-fidelity modes"). The EXACT mode is the model of
-    # record for every validity artifact; its per-publish cost at this
-    # shape is measured below and reported as publish_exact_s: at
-    # heartbeat < dissemination span, queued answers bind on every message
-    # and the exact repair pays ~15-20 extra fixpoint passes.
+    # Throughput is measured in the EXACT delivery mode (DELIVERY_MODE
+    # above): serialized answer queues are the model of record, and since
+    # the parallel-prefix answer-queue engine (SimParams.answer_queue_mode,
+    # the default) replaced the serial from-INF refinement sweeps, its
+    # per-publish cost sits close enough to the bounded pipeline to be the
+    # default at this shape. The bounded mode and the legacy serial engine
+    # are both still measured below as probes (publish_bounded_s,
+    # publish_exact_serial_s) so the artifact carries the mode gap and the
+    # engine speedup on every run.
     import dataclasses
 
     # warm_start: cross-publish warm-started fixpoints (certified +
@@ -158,10 +187,15 @@ def main() -> None:
     # the guard's untaken branch costs compile time only, which the bench
     # excludes. A cold-publish timing below attributes the actual benefit.
     params = SimParams(n=N_PEERS, capacity=graph.capacity,
-                       serialize_answers=False, warm_start=True)
+                       serialize_answers=True, warm_start=True)
     params_cold = dataclasses.replace(params, warm_start=False)
-    params_exact = dataclasses.replace(params, serialize_answers=True,
-                                       warm_start=False)
+    # the bounded-accounting probe mirrors the timed mode's warm carry so
+    # publish_bounded_s stays comparable to the pre-flip artifacts' timed
+    # publishes; the engine A/B holds everything BUT the engine fixed
+    # (exact, cold) so the ratio isolates prefix vs serial refinement
+    params_bounded = dataclasses.replace(params, serialize_answers=False)
+    params_serial = dataclasses.replace(params_cold,
+                                        answer_queue_mode="serial")
     state = init_state(params, seed=0)
     a = graph_arrays(graph)
     import jax.numpy as jnp
@@ -235,43 +269,53 @@ def main() -> None:
         wall = min(wall, time.time() - t0)
     # per-phase split from a SEPARATE instrumented pass: the inner syncs it
     # needs would change dispatch overlap inside the metric-of-record loop,
-    # so they must not ride there
-    hb_s = 0.0
-    dis_s = 0.0
+    # so they must not ride there. The raw synced sums can exceed the
+    # overlapped wall (that's what the syncs remove); attribution_split
+    # rescales them into disjoint components of the real wall for the
+    # artifact, and the raw values ship as *_sync_s
+    hb_sync_s = 0.0
+    dis_sync_s = 0.0
     for i in range(MESSAGES):
         t1 = time.time()
         state = hb(state, per_burst)
         jax.block_until_ready(state.t_ms)
-        hb_s += time.time() - t1
+        hb_sync_s += time.time() - t1
         t1 = time.time()
         _, state = publish(state, 7 + i)
         jax.block_until_ready(state.bytes_tx)
-        dis_s += time.time() - t1
+        dis_sync_s += time.time() - t1
+    hb_s, dis_s = attribution_split(wall, hb_sync_s, dis_sync_s)
 
     # attribution pass: fixpoint-only vs full publish on a FIXED state.
     # The wrapper jit returns ONLY delay_ms, so XLA dead-code-eliminates
     # the post-fixpoint accounting (pulls, rx fold, counters, write-backs)
     # from the inlined disseminate — the difference against the full call
     # is the accounting cost (VERDICT r3 ask #4's per-pull attribution).
-    def _probe(keep):
+    def _probe(keep, p):
         def go(s, pub):
             res, _ = disseminate(
                 s, a["conns"], a["rev"], stage, lat, bw, publisher=pub,
-                t0_ms=s.t_ms, params=params, payload_bytes=15000,
+                t0_ms=s.t_ms, params=p, payload_bytes=15000,
                 lat_edge=lat_edge, ans_tables=ans_tables,
                 valid_edge=valid_edge,
             )
             return tuple(getattr(res, k) for k in keep)
         return jax.jit(go)
 
-    # number-by-number floor: delay_ms alone keeps only the fixpoints;
-    # adding answer_wait keeps the final-times answer-queue fold too — the
-    # difference isolates the fold from the rest of the accounting
-    fix_fn = _probe(("delay_ms",))
-    fold_fn = _probe(("delay_ms", "answer_wait_max_ms"))
+    # number-by-number floor: delay_ms alone keeps only the fixpoints (in
+    # the exact timed mode that includes the prefix refinement — delays
+    # depend on it); the fold probe runs on the BOUNDED params, where
+    # adding answer_wait keeps the final-times answer-queue fold live too
+    # — the difference against the bounded fixpoint isolates the fold (in
+    # exact mode the wait bar is a structural 0.0 and would DCE to nothing)
+    fix_fn = _probe(("delay_ms",), params)
+    bfix_fn = _probe(("delay_ms",), params_bounded)
+    fold_fn = _probe(("delay_ms", "answer_wait_max_ms"), params_bounded)
     jax.block_until_ready(fix_fn(state, 11))        # compile
+    jax.block_until_ready(bfix_fn(state, 11))
     jax.block_until_ready(fold_fn(state, 11))
     fix_s = np.inf
+    bfix_s = np.inf
     fold_s = np.inf
     full_s = np.inf
     cold_s = np.inf
@@ -281,6 +325,9 @@ def main() -> None:
         t1 = time.time()
         jax.block_until_ready(fix_fn(state, 12 + i))
         fix_s = min(fix_s, time.time() - t1)
+        t1 = time.time()
+        jax.block_until_ready(bfix_fn(state, 12 + i))
+        bfix_s = min(bfix_s, time.time() - t1)
         t1 = time.time()
         jax.block_until_ready(fold_fn(state, 12 + i))
         fold_s = min(fold_s, time.time() - t1)
@@ -293,38 +340,63 @@ def main() -> None:
         jax.block_until_ready(s2.bytes_tx)
         cold_s = min(cold_s, time.time() - t1)
 
-    # model-fidelity attribution (r5): the same publish in the EXACT
-    # serialized-answer mode (the model of record). The difference against
-    # publish_full_s is the honest cost of exact answer-queue
-    # serialization at this shape, where heartbeat < dissemination span
-    # makes queued answers bind on every message (~15-20 extra fixpoint
-    # passes of tick/request refinement).
-    def _exact(s, pub):
-        res, s = disseminate(
-            s, a["conns"], a["rev"], stage, lat, bw, publisher=pub,
-            t0_ms=s.t_ms, params=params_exact, payload_bytes=15000,
-            lat_edge=lat_edge, ans_tables=ans_tables, valid_edge=valid_edge,
-        )
-        return res, s
+    # mode + engine attribution (r5 ask, flipped): the timed loop IS the
+    # exact mode now, so the probes measure (a) the same publish with the
+    # bounded accounting — the remaining mode gap — and (b) the exact
+    # publish refined by the LEGACY serial engine
+    # (answer_queue_mode="serial", the pre-prefix model of record), both
+    # min-of-3 on the fixed state. serial/cold is the engine speedup the
+    # prefix refinement buys at this shape with everything else held fixed.
+    def _mode_probe(p):
+        def go(s, pub):
+            res, s = disseminate(
+                s, a["conns"], a["rev"], stage, lat, bw, publisher=pub,
+                t0_ms=s.t_ms, params=p, payload_bytes=15000,
+                lat_edge=lat_edge, ans_tables=ans_tables,
+                valid_edge=valid_edge,
+            )
+            return res, s
+        return go
 
-    r0, s0 = _exact(state, 21)
+    bounded_s = np.inf
+    serial_s = np.inf
+    _bounded = _mode_probe(params_bounded)
+    _serial = _mode_probe(params_serial)
+    _, s0 = _bounded(state, 21)
     jax.block_until_ready(s0.bytes_tx)              # compile
-    exact_s = np.inf
+    _, s0 = _serial(state, 21)
+    jax.block_until_ready(s0.bytes_tx)              # compile
     for i in range(3):
         t1 = time.time()
-        _, s2 = _exact(state, 22 + i)
+        _, s2 = _bounded(state, 22 + i)
         jax.block_until_ready(s2.bytes_tx)
-        exact_s = min(exact_s, time.time() - t1)
+        bounded_s = min(bounded_s, time.time() - t1)
+        t1 = time.time()
+        _, s2 = _serial(state, 22 + i)
+        jax.block_until_ready(s2.bytes_tx)
+        serial_s = min(serial_s, time.time() - t1)
 
-    # sanity gates on the mode attribution (VERDICT r5 "What's weak" #2):
-    # exact mode strictly ADDS work (the serialized repair + its triggers)
-    # on top of the same bounded pipeline, so a faster-or-zero exact
-    # timing means the probe measured nothing (e.g. a cached/DCE'd call)
-    # and the artifact must not ship it
-    assert exact_s > 0.0, "publish_exact_s == 0.0: exact probe measured nothing"
-    assert exact_s >= full_s, (
-        f"publish_exact_s ({exact_s:.3f}) < publish_full_s ({full_s:.3f}): "
-        "exact mode strictly adds work; the attribution pass is broken")
+    # sanity gates on the mode/engine attribution (VERDICT r5 "What's
+    # weak" #2, reworked for the exact-default flip): a zero timing means
+    # the probe measured nothing (a cached/DCE'd call) and the artifact
+    # must not ship it. The old `exact >= bounded-full` ordering gate is
+    # gone by design — the prefix engine's whole point is closing that gap,
+    # so the gap is REPORTED (publish_bounded_s vs publish_exact_s), not
+    # asserted on.
+    assert full_s > 0.0, "publish_exact_s == 0.0: probe measured nothing"
+    assert bounded_s > 0.0, (
+        "publish_bounded_s == 0.0: bounded probe measured nothing")
+    assert serial_s > 0.0, (
+        "publish_exact_serial_s == 0.0: serial-engine probe measured nothing")
+    # the exactness certificate of the timed loop: in the exact mode every
+    # timed publish must reach self-consistency (prefix certificate, or
+    # the serial certificate after the nested fallback) — a capped
+    # fixpoint would silently ship approximate times under an exact label
+    if DELIVERY_MODE == "exact":
+        assert all(bool(np.asarray(r.converged)) for r in results), (
+            "exact-mode timed publish did not converge under the "
+            "iteration cap; the artifact would mislabel approximate times "
+            "as exact")
 
     # adversarial-campaign probe (ops/adversary.py): one sybil graft-flood
     # window + one censored publish at the bench shape, timed as a single
@@ -484,47 +556,58 @@ def main() -> None:
             "rounds": rounds,
             "wall_s": round(wall, 3),
             # per-phase split so heartbeat vs dissemination regressions are
-            # attributable across rounds
+            # attributable across rounds. hb_s/disseminate_s are DISJOINT
+            # components of wall_s (attribution_split rescales the synced
+            # shares onto the overlapped wall, so they sum to wall_s —
+            # the r05 artifact's disseminate_s > wall_s confusion is
+            # structurally gone); the raw per-phase synced times ship as
+            # *_sync_s and may legitimately sum above wall_s
             "hb_s": round(hb_s, 3),
             "disseminate_s": round(dis_s, 3),
+            "hb_sync_s": round(hb_sync_s, 3),
+            "disseminate_sync_s": round(dis_sync_s, 3),
             # one-publish attribution on a fixed state (min of 3):
             # fixpoint_s = the two-phase arrival fixpoint alone (accounting
-            # DCE'd); accounting_s = what the post-fixpoint pulls, rx fold,
+            # DCE'd; includes the prefix refinement in the exact timed
+            # mode); accounting_s = what the post-fixpoint pulls, rx fold,
             # counters and write-backs add on top
             "fixpoint_s": round(fix_s, 3),
             "accounting_s": round(max(full_s - fix_s, 0.0), 3),
-            # fold_s isolates the final-times answer-queue fold (the wait
-            # bar) from the rest of the accounting: keep delay_ms +
-            # answer_wait_max_ms live, DCE everything else
-            "fold_s": round(max(fold_s - fix_s, 0.0), 3),
+            # fold_s isolates the final-times answer-queue fold (the
+            # bounded mode's wait bar) from the rest of the accounting,
+            # measured on the bounded probe where the bar is live: keep
+            # delay_ms + answer_wait_max_ms, DCE everything else, subtract
+            # the bounded fixpoint floor
+            "fold_s": round(max(fold_s - bfix_s, 0.0), 3),
             "publish_full_s": round(full_s, 3),
-            # the same bounded publish with the cross-publish warm carry
+            # the same exact publish with the cross-publish warm carry
             # disabled: the measured (wavefront-limited) warm-start benefit
             "publish_cold_s": round(cold_s, 3),
-            # bounded vs exact delivery mode (see SimParams
-            # .serialize_answers): the timed loop runs bounded; this is
-            # the exact-mode publish on the same state — the measured
-            # price of exact answer-queue serialization at this shape
-            "delivery_mode": "bounded",
-            "publish_exact_s": round(exact_s, 3),
-            # the bounded mode's per-hop arrival-time error bar: max time
-            # any requested answer waited queued (ms), max over messages.
-            # ALWAYS finite now — the interleaved-rounds corner (where the
-            # per-round fold's bar is unreliable) is a separate COUNT
-            # field instead of the old INF poison that leaked invalid-JSON
-            # Infinity into this artifact; the min() guard keeps the
-            # artifact strict-JSON even if a future regression reintroduces
-            # an infinite bar (json.dumps below also refuses NaN/Inf)
-            "answer_wait_max_ms": round(
-                min(max(float(np.asarray(r.answer_wait_max_ms))
-                        for r in results), 3.0e38), 3),
-            # fragment lanes whose gossip announce rounds interleaved at
-            # the final times (fold exactness precondition failed there),
-            # summed over the timed messages; 0 at reference heartbeats
-            "answer_interleaved": int(sum(
-                int(np.asarray(r.answer_interleaved)) for r in results)),
+            # delivery-fidelity attribution (see SimParams
+            # .serialize_answers and README "Delivery-fidelity modes"):
+            # the timed loop runs the EXACT mode (model of record) on the
+            # parallel-prefix engine; publish_exact_s is its measured
+            # publish (== publish_full_s in this mode), publish_bounded_s
+            # the bounded-accounting publish on the same state (the
+            # remaining mode gap), publish_exact_serial_s the exact
+            # publish refined by the legacy serial engine — over
+            # publish_cold_s (same cold exact publish, prefix engine) it
+            # is the engine speedup the prefix refinement buys
+            "delivery_mode": DELIVERY_MODE,
+            "publish_exact_s": round(full_s, 3),
+            "publish_bounded_s": round(bounded_s, 3),
+            "publish_exact_serial_s": round(serial_s, 3),
+            "exact_serial_over_prefix": round(serial_s / max(cold_s, 1e-9),
+                                              2),
+            # max refinement passes any timed publish paid (prefix Jacobi
+            # iterations; prefix + serial outer passes if the certificate
+            # ever fell back): the retrace-free analogue of the serial
+            # engine's ~15-20 from-INF sweeps
+            "refine_passes": int(max(
+                int(np.asarray(r.refine_passes)) for r in results)),
             # every timed fixpoint reached self-consistency under the
-            # iteration cap
+            # iteration cap (in exact mode this is the exactness
+            # certificate — asserted above, reported here)
             "converged": bool(all(
                 bool(np.asarray(r.converged)) for r in results)),
             "backend": jax.default_backend(),
@@ -559,6 +642,19 @@ def main() -> None:
             "p99_ms": float(np.percentile(delays[ok], 99)),
         },
     }
+    # bounded-only keys, keyed by the mode field (satellite contract: a
+    # consumer checks delivery_mode, not key presence heuristics): the
+    # wait bar and the interleaved-lane count are the bounded mode's error
+    # accounting — in exact mode both are structural zeros and are OMITTED
+    # rather than emitted as meaningless 0.0s. The min() guard keeps the
+    # bar strict-JSON even if a regression reintroduces an infinite value
+    # (sanitize_nonfinite + allow_nan=False below are the hard backstops).
+    if DELIVERY_MODE == "bounded":
+        out["detail"]["answer_wait_max_ms"] = round(
+            min(max(float(np.asarray(r.answer_wait_max_ms))
+                    for r in results), 3.0e38), 3)
+        out["detail"]["answer_interleaved"] = int(sum(
+            int(np.asarray(r.answer_interleaved)) for r in results))
     # roofline block (runtime/profiling.py): per-entrypoint XLA cost
     # analysis + retrace counts over the contract registry. Env-gated —
     # lowering every registered entrypoint at bench shapes costs real

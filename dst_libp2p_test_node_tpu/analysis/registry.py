@@ -8,10 +8,16 @@ entrypoint concretely (checkify mode excepted).
 
 The registered surface mirrors the BENCH hot paths exactly:
 
-  disseminate/cold        serialized-answer publish (1 surviving cond: the
-                          exact-mode repair branch)
-  disseminate/warm        warm-started publish (2 surviving conds: repair +
-                          the cold-rerun guard)
+  disseminate/cold        serialized-answer publish (2 surviving conds: the
+                          exact-mode repair branch plus the nested
+                          prefix-certificate fallback to the legacy serial
+                          refiner)
+  disseminate/warm        warm-started publish (3 surviving conds: repair +
+                          certificate fallback + the cold-rerun guard)
+  disseminate/exact_serial
+                          the legacy serial refiner forced via
+                          answer_queue_mode="serial" (1 surviving cond: the
+                          repair branch only — no nested fallback to trace)
   disseminate/bounded     bounded-accounting publish (cond-free by design)
   heartbeat_step          one mesh-maintenance round (4 steady-state skips)
   run_heartbeats          the simulator scan step (conds must survive the
@@ -434,19 +440,33 @@ def default_contracts() -> list[EntrypointContract]:
         EntrypointContract(
             name="disseminate/cold",
             build=lambda: _disseminate_spec(),
-            expected_conds=1,
+            expected_conds=2,
             donate=(0,),
             ladder=_disseminate_ladder,
             expected_compile_keys=3,
             feedback=[(_new_state_of, _state_arg_of)],
             runtime_check=_checkify_disseminate,
-            notes="serialized-answer repair branch must stay a real cond"),
+            notes="serialized-answer repair branch must stay a real cond, "
+                  "and the prefix-certificate fallback to the legacy serial "
+                  "refiner must stay a NESTED cond inside it (the untaken "
+                  "serial branch costs compile only — converting either to "
+                  "select_n would run the serial refiner on every publish)"),
         EntrypointContract(
             name="disseminate/warm",
             build=lambda: _disseminate_spec(warm_start=True),
-            expected_conds=2,
+            expected_conds=3,
             feedback=[(_new_state_of, _state_arg_of)],
-            notes="repair + cold-rerun guard both survive"),
+            notes="repair + certificate fallback + cold-rerun guard all "
+                  "survive"),
+        EntrypointContract(
+            name="disseminate/exact_serial",
+            build=lambda: _disseminate_spec(answer_queue_mode="serial"),
+            expected_conds=1,
+            feedback=[(_new_state_of, _state_arg_of)],
+            notes="the legacy serial refiner forced by static param — the "
+                  "bit-equality reference the prefix engine is pinned "
+                  "against (tests/test_exact_prefix.py); only the repair "
+                  "branch survives, there is no nested fallback to trace"),
         EntrypointContract(
             name="disseminate/bounded",
             build=lambda: _disseminate_spec(serialize_answers=False),
@@ -555,7 +575,7 @@ def default_contracts() -> list[EntrypointContract]:
         EntrypointContract(
             name="multitopic/disseminate",
             build=_multitopic_spec,
-            expected_conds=1,
+            expected_conds=2,
             feedback=[(_new_state_of, _state_arg_of)],
             notes="T*N block-diagonal stack keeps the single-topic conds"),
     ]

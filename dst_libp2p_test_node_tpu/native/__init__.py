@@ -1,0 +1,3 @@
+"""Native acceleration surfaces: the C++ log-emitter sources (liblogemit.so,
+loaded by runtime/native_logemit.py) and the Pallas VMEM-gather kernel
+(vmem_gather.py) behind its runtime capability probe."""

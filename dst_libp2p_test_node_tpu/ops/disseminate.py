@@ -218,6 +218,19 @@ class DisseminationResult:
     #                            was CUT at params.max_relax_iters and the
     #                            times/error bar may be off — previously
     #                            this was silently reported as exact.
+    refine_passes: jnp.ndarray  # () int32 — exact mode only: refinement
+    #                            iterations the serialized-answer repair
+    #                            spent, max over fragment lanes (prefix
+    #                            mode: Jacobi iterations of both phases;
+    #                            after a fallback to the global-sort path,
+    #                            the prefix iterations already spent plus
+    #                            the serial outer passes). 0 whenever the
+    #                            fast pipeline was kept (no queued answer
+    #                            could have been a first delivery) and in
+    #                            bounded / no-gossip mode. The tier-1
+    #                            pass-count budget of the exactness
+    #                            certificate pins this on canonical
+    #                            topologies (tests/test_exact_prefix.py).
 
 
 def _stage_select(stage: jnp.ndarray, n_stages: int, conns: jnp.ndarray,
@@ -922,6 +935,7 @@ def disseminate(
                 can_send, g_deliver, g_off, hb_phase, uplink, rx_const,
                 params.proc_delay_ms, params.heartbeat_ms, with_gossip,
                 lat_deliver=ld, ld_gossip=_ld_ans(frag_idx),
+                packed=params.packed_state,
             )
             return converge_sharded(t0, c, params.max_relax_iters, mesh)
         if exceeds_budget(jnp.float32, conns.shape, fragments):
@@ -937,6 +951,7 @@ def disseminate(
                 can_send, g_deliver, g_off, hb_phase, uplink, rx_const,
                 params.proc_delay_ms, params.heartbeat_ms, with_gossip,
                 lat_deliver=ld, ld_gossip=_ld_ans(frag_idx),
+                packed=params.packed_state,
             )
             return converge_recv(t0, c, params.max_relax_iters)
         # single device below the budget: sender-major offers (loop-invariant
@@ -1000,7 +1015,7 @@ def disseminate(
                 conns, rev, lat_edge, tx_ms, rank, k_p, frag_idx, deliver,
                 can_send, g_tgt, g_off, hb_phase, uplink, rx_const,
                 params.proc_delay_ms, params.heartbeat_ms, False,
-                lat_deliver=ld,
+                lat_deliver=ld, packed=params.packed_state,
             )
             if mesh is not None:
                 t_rx, _, _ = converge_sharded(
@@ -1053,11 +1068,12 @@ def disseminate(
         contradiction. `t_seed`: optional starting estimate for the gossip
         terms (e.g. the phase-1 result), purely a convergence accelerator.
 
-        Returns (t, converged): `converged` is the final no-change bit of
-        the outer loop — False means the iteration cap cut the refinement
-        and t is NOT certified self-consistent (the caller surfaces this
-        on DisseminationResult.converged instead of silently reporting a
-        0.0 error bar)."""
+        Returns (t, converged, passes): `converged` is the final no-change
+        bit of the outer loop — False means the iteration cap cut the
+        refinement and t is NOT certified self-consistent (the caller
+        surfaces this on DisseminationResult.converged instead of silently
+        reporting a 0.0 error bar); `passes` the outer passes spent
+        (DisseminationResult.refine_passes)."""
         sv = _frag_slice(survive, frag_idx)
 
         def cond(carry):
@@ -1078,9 +1094,82 @@ def disseminate(
 
         t0 = (jnp.full((n,), INF) if t_seed is None else t_seed
               ).at[publisher].set(t_pub)
-        _, t, changed, _ = jax.lax.while_loop(
+        _, t, changed, it = jax.lax.while_loop(
             cond, body, (t0, t0, jnp.bool_(True), jnp.int32(0)))
-        return t, ~changed
+        return t, ~changed, it
+
+    def _converge_prefix(rank, k_p, frag_idx, t_pub, send_mask, t_seed):
+        """Exact fixpoint of the SERIALIZED answer model by scan-free
+        Jacobi iteration — the parallel-prefix replacement for the
+        _converge_serialized outer loop. One iteration evaluates the full
+        candidate map F at the current estimate and takes it wholesale:
+        the lat-sorted answer-queue fold (gossip_fold — itself a
+        parallel-prefix cumsum/cummax over the static service order, no
+        global argsort) gives every edge's serialized answer offer, the
+        hoisted mesh bases give the uplink-queue offers, and ONE merged
+        pull yields t_{k+1} = max(min incoming offer, downlink clamp) with
+        the publisher pinned. Because each estimate is recomputed FRESH
+        (not min-folded into the previous one), the iteration handles the
+        system's non-monotonicity in both directions — raising an
+        announcer's estimate delays its IHAVE and may REMOVE a requested
+        job, making other answers earlier — where a warm min-only
+        relaxation would undershoot and stick (the r5 review catch that
+        forced _converge_serialized's from-INF restarts).
+
+        The exactness certificate is unchanged: the loop exits on a
+        bitwise no-change pass, i.e. F(t) == t — the result is
+        SELF-CONSISTENT (t = min(candidates(t)) with every gossip term
+        evaluated at t), and any self-consistent point equals the DES's
+        chronological fixpoint by the earliest-wrong-peer argument in
+        _converge_serialized's docstring. What changes is the per-pass
+        price: one fold + one pull, vs the serial path's global (N, H*C)
+        argsort + a full from-INF mesh relaxation (~graph-diameter pulls)
+        per outer pass.
+
+        Returns (t, g_abs, req, drain, mixed, converged, passes) — the
+        gossip triple and `mixed` are the FINAL evaluation's (the
+        no-change pass ran the fold at the fixpoint, so they ride out for
+        free); `mixed` or ~converged sends the caller to the global-sort
+        fallback, whose round-interleaving-proof sort covers the corner
+        the per-round fold cannot certify."""
+        sv = _frag_slice(survive, frag_idx)
+        ld = _ld_mesh(frag_idx)
+        deliver = send_mask if sv is None else send_mask & sv
+        queue = (rank + 1.0 + frag_idx * k_p[:, None]) * tx_ms[:, None]
+        a_base = jnp.where(
+            deliver & can_send[:, None], queue + ld, INF)
+        t0 = t_seed.at[publisher].set(t_pub)
+        not_pub = jnp.arange(n) != publisher
+
+        def cond(carry):
+            changed, it = carry[-2], carry[-1]
+            return changed & (it < params.max_relax_iters)
+
+        def body(carry):
+            t_g, _, _, _, _, _, it = carry
+            g_abs, req, drain, mixed, _ = gossip_fold(t_g, frag_idx)
+            # merged candidates: mesh offers + SV-masked serialized answer
+            # offers (every sampled surviving edge offers, matching the
+            # serial path — an offer only binds for a still-lacking, hence
+            # requesting, receiver)
+            g_d = g_abs if sv is None else jnp.where(sv, g_abs, INF)
+            live = (t_g < INF)[:, None]
+            start = jnp.maximum(t_g + params.proc_delay_ms, uplink)
+            cand = jnp.where(live, start[:, None] + a_base, INF)
+            cand = jnp.minimum(cand, jnp.where(live, g_d, INF))
+            inc = pull(cand)
+            t_new = jnp.where(
+                not_pub,
+                jnp.maximum(inc.min(axis=-1), rx_const), t_pub)
+            return (t_new, g_abs, req, drain, mixed,
+                    jnp.any(t_new != t_g), it + 1)
+
+        t, g_abs, req, drain, mixed, changed, it = jax.lax.while_loop(
+            cond, body,
+            (t0, jnp.full((n, c), INF), jnp.zeros((n, c), bool),
+             jnp.zeros((n,), jnp.float32), jnp.bool_(False),
+             jnp.bool_(True), jnp.int32(0)))
+        return t, g_abs, req, drain, mixed, ~changed, it
 
     def queue_drop(tgt_mask, frag_idx):
         """Priority-queue drop model (main.nim:264-299). The reference's
@@ -1290,27 +1379,69 @@ def disseminate(
         tgt_f = queue_drop(tgt, frag_idx)
         rank1 = _ranks_f32(jnp.where(tgt_f, rprio, INF))
         k1 = tgt_f.sum(axis=-1).astype(jnp.float32)
-        t1, conv1 = _converge_serialized(rank1, k1, frag_idx, t_pub, tgt_f,
-                                         t_seed=t_seed)
+        t1, conv1, it1 = _converge_serialized(rank1, k1, frag_idx, t_pub,
+                                              tgt_f, t_seed=t_seed)
         if not params.exclude_first_sender:
             g2, req2, drain2 = gossip_serial_exact(t1, frag_idx)
             inc2 = pull(offers(t1, rank1, k1, frag_idx, tgt_f,
                                deliver_only=True,
                                g_abs=jnp.where(req2, g2, INF)))
-            return t1, rank1, k1, tgt_f, g2, req2, drain2, inc2, conv1
+            return t1, rank1, k1, tgt_f, g2, req2, drain2, inc2, conv1, it1
         g1, req1, _ = gossip_serial_exact(t1, frag_idx)
         inc1 = pull(offers(t1, rank1, k1, frag_idx, tgt_f,
                            deliver_only=True,
                            g_abs=jnp.where(req1, g1, INF)))
         rank2, k2, send_mask = _phase2_masks_from_inc(
             inc1, t1, rank1, k1, tgt_f)
-        t2, conv2 = _converge_serialized(rank2, k2, frag_idx, t_pub,
-                                         send_mask, t_seed=t1)
+        t2, conv2, it2 = _converge_serialized(rank2, k2, frag_idx, t_pub,
+                                              send_mask, t_seed=t1)
         g2, req2, drain2 = gossip_serial_exact(t2, frag_idx)
         inc2 = pull(offers(t2, rank2, k2, frag_idx, send_mask,
                            deliver_only=True,
                            g_abs=jnp.where(req2, g2, INF)))
-        return t2, rank2, k2, send_mask, g2, req2, drain2, inc2, conv1 & conv2
+        return (t2, rank2, k2, send_mask, g2, req2, drain2, inc2,
+                conv1 & conv2, it1 + it2)
+
+    def phases_prefix(frag_idx, t_pub, t_seed):
+        """PARALLEL-PREFIX serialized pipeline (the exact-mode default,
+        params.answer_queue_mode="parallel_prefix"): the same two-phase
+        structure as phases_serial with _converge_prefix supplying both
+        fixpoints — exact answer queues inside the delivery times at one
+        fold + one pull per refinement iteration, no global sorts, no
+        from-INF restarts. Reached only from the trigger-gated slow
+        branch; `t_seed` is the fast pipeline's final times, so the Jacobi
+        iteration starts from a near-correct estimate and spends
+        tick/request-refinement iterations, not reach-expansion ones.
+
+        Returns the phases_serial 10-tuple with element 8 = the COMBINED
+        certificate (both phases reached a bitwise F(t)==t pass AND
+        neither's final fold saw interleaved announce rounds). A False
+        certificate means the prefix times are NOT certified exact —
+        the caller's nested cond reruns the global-sort pipeline, whose
+        sort-order exactness covers the interleaved corner."""
+        tgt_f = queue_drop(tgt, frag_idx)
+        rank1 = _ranks_f32(jnp.where(tgt_f, rprio, INF))
+        k1 = tgt_f.sum(axis=-1).astype(jnp.float32)
+        t1, g1, req1, drain1, mixed1, conv1, it1 = _converge_prefix(
+            rank1, k1, frag_idx, t_pub, tgt_f, t_seed)
+        # attribution pull: gossip offers masked to ANSWERED edges — an
+        # unanswered edge's hypothetical offer must not steal the
+        # first-sender argmin (same masking as phases_serial)
+        inc1 = pull(offers(t1, rank1, k1, frag_idx, tgt_f,
+                           deliver_only=True,
+                           g_abs=jnp.where(req1, g1, INF)))
+        if not params.exclude_first_sender:
+            return (t1, rank1, k1, tgt_f, g1, req1, drain1, inc1,
+                    conv1 & ~mixed1, it1)
+        rank2, k2, send_mask = _phase2_masks_from_inc(
+            inc1, t1, rank1, k1, tgt_f)
+        t2, g2, req2, drain2, mixed2, conv2, it2 = _converge_prefix(
+            rank2, k2, frag_idx, t_pub, send_mask, t1)
+        inc2 = pull(offers(t2, rank2, k2, frag_idx, send_mask,
+                           deliver_only=True,
+                           g_abs=jnp.where(req2, g2, INF)))
+        return (t2, rank2, k2, send_mask, g2, req2, drain2, inc2,
+                conv1 & conv2 & ~mixed1 & ~mixed2, it1 + it2)
 
     # publisher emits fragments back-to-back (main.nim:177-179)
     frag_ids = jnp.arange(fragments, dtype=jnp.float32)
@@ -1348,6 +1479,7 @@ def disseminate(
     answer_wait = jnp.max(wait_f)
     answer_interleaved = jnp.sum(mixed_f.astype(jnp.int32))
     converged = jnp.all(ok_f)
+    refine_passes = jnp.int32(0)
     if with_gossip and params.serialize_answers:
         # serialized-answer repair, decided ONCE per message on a SCALAR
         # predicate (_diverged): the fast pipeline is kept whenever no
@@ -1358,19 +1490,56 @@ def disseminate(
         # lower to select_n and execute both branches every publish (the
         # r5 review + bench catch). The fast results ride in as the
         # operand: the slow pipeline seeds its gossip estimates from them.
-        def _slow(fr):
-            t_fast = fr[0]
-            outs = [phases_serial(frag_ids[i], t_pubs[i], t_fast[i])
+        #
+        # Engine selection (static): the parallel-prefix pipeline needs
+        # the single-device row-gather pull its Jacobi body is built
+        # around, so it runs exactly where _converge_dyn picks that
+        # dispatch — mesh-free and under the memory budget (the nested
+        # device grids call disseminate with mesh=None inside pjit, so
+        # they ride it too). Elsewhere, and under answer_queue_mode=
+        # "serial" (the reference engine the prefix path is pinned
+        # against), the global-sort pipeline runs as before.
+        use_prefix = (params.answer_queue_mode == "parallel_prefix"
+                      and mesh is None
+                      and not exceeds_budget(jnp.float32, conns.shape,
+                                             fragments))
+
+        def _serial_all(seed):
+            outs = [phases_serial(frag_ids[i], t_pubs[i], seed[i])
                     for i in range(fragments)]
             return tuple(jnp.stack(x) for x in zip(*outs))
 
+        def _slow(fr):
+            t_fast = fr[0]
+            if not use_prefix:
+                return _serial_all(t_fast)
+            outs = [phases_prefix(frag_ids[i], t_pubs[i], t_fast[i])
+                    for i in range(fragments)]
+            pref = tuple(jnp.stack(x) for x in zip(*outs))
+
+            # certificate-gated fallback (nested scalar cond): any
+            # fragment the prefix engine could not certify — interleaved
+            # announce rounds or an iteration-capped Jacobi loop — reruns
+            # ALL fragments through the global-sort pipeline, seeded from
+            # the prefix times. Untaken, the legacy branch costs compile
+            # time only (the repo's warm-rerun idiom); its pass count adds
+            # to the prefix iterations already spent.
+            def _legacy(p):
+                leg = _serial_all(p[0])
+                return leg[:9] + (p[9] + leg[9],)
+
+            return jax.lax.cond(
+                jnp.all(pref[8]), lambda p: p, _legacy, pref)
+
         # the convergence bit rides the cond operand so the kept branch's
-        # verdict (fast ok / serialized outer-loop no-change) wins
-        fast9 = jax.lax.cond(
+        # verdict (fast ok / serialized refinement certificate) wins; the
+        # pass counter rides alongside (0 when the fast pipeline is kept)
+        fast10 = jax.lax.cond(
             jnp.any(hint_f), _slow, lambda fr: fr,
-            fast_results + (ok_f,))
-        fast_results, conv_f = fast9[:8], fast9[8]
+            fast_results + (ok_f, jnp.zeros((fragments,), jnp.int32)))
+        fast_results, conv_f, passes_f = fast10[:8], fast10[8], fast10[9]
         converged = jnp.all(conv_f)
+        refine_passes = jnp.max(passes_f)
         # exact mode: the repair drives the delivery error to zero
         answer_wait = jnp.float32(0.0)
         answer_interleaved = jnp.int32(0)
@@ -1557,6 +1726,7 @@ def disseminate(
         answer_wait_max_ms=answer_wait,
         answer_interleaved=answer_interleaved,
         converged=converged,
+        refine_passes=refine_passes,
     )
     dup = jnp.maximum(copies - fragments, 0)
     # uplink occupancy write-back: per fragment, frag_accounting computed the

@@ -103,6 +103,25 @@ class SimParams:
     # of the whole fast pipeline, which long publish loops amortize but
     # one-shot calls should not pay.
     warm_start: bool = False
+    # Exact-repair engine selection (only read when serialize_answers=True):
+    # "parallel_prefix" (default) runs the scan-free Jacobi refinement —
+    # one answer-queue fold + one candidate pull per iteration, with the
+    # serialized global-sort pipeline kept as an in-trace fallback cond for
+    # the cases the fold cannot certify (interleaved announce rounds, cap
+    # cut). "serial" forces the legacy global-sort outer iteration
+    # everywhere — the reference implementation the prefix path is
+    # bit/rtol-pinned against (tests/test_exact_prefix.py).
+    answer_queue_mode: str = "parallel_prefix"
+    # Packed dissemination constants (ARCHITECTURE §6): store the per-edge
+    # RELATIVE cost tables of the receiver-side fixpoint formulation
+    # (parallel/exchange.py RecvConstants) as bf16 and fold the validity
+    # masks into the bf16 +inf sentinel, halving the memory-bound carry's
+    # HBM traffic on the budget/sharded dispatch paths. Absolute-time
+    # fields and the accounting fold stay f32 (bf16's 8-bit mantissa
+    # resolves only ~4 s at a 1e6 ms sim clock). OFF by default: the ~2 ms
+    # per-edge quantization is inside the bounded mode's error bar but
+    # breaks the exact mode's model-of-record bit guarantees.
+    packed_state: bool = False
     exclude_first_sender: bool = True   # don't forward back to the delivering peer
     idontwant_threshold_bytes: int = 1000  # go-test-node/main.go:165 (v1.2)
     churn_down_per_hb: float = 0.0  # P(alive peer dies) per heartbeat
@@ -149,6 +168,10 @@ class SimParams:
                 f"px_count must be in [1, {PX_POOL_WIDTH}], got {self.px_count}")
         if self.redial_patience < 1:
             raise ValueError("redial_patience must be >= 1")
+        if self.answer_queue_mode not in ("parallel_prefix", "serial"):
+            raise ValueError(
+                "answer_queue_mode must be 'parallel_prefix' or 'serial', "
+                f"got {self.answer_queue_mode!r}")
 
     @classmethod
     def from_gossipsub(

@@ -35,6 +35,7 @@ analyses:
 from __future__ import annotations
 
 import logging
+import os
 from contextlib import contextmanager
 
 import numpy as np
@@ -178,15 +179,26 @@ def measure_retraces(contract) -> int:
     return counter.count
 
 
-def roofline(contracts=None, with_retraces: bool = True) -> dict:
+def roofline(contracts=None, with_retraces: bool = True,
+             name_prefix: str | None = None) -> dict:
     """The per-entrypoint roofline block: contract name -> {flops,
     hbm_bytes, peak_memory_bytes, retraces, retrace_budget} (strict-JSON
     safe; a contract that cannot lower on this backend reports an `error`
-    string instead of crashing the caller — bench must keep emitting)."""
+    string instead of crashing the caller — bench must keep emitting).
+
+    `name_prefix` restricts the sweep to contracts whose name starts with
+    it (e.g. "disseminate/" for the publish-entrypoint CI artifact — the
+    full registry costs minutes of compiles, the publish family seconds).
+    Also honored via the BENCH_ROOFLINE_ONLY env var when the caller does
+    not pass one."""
+    if name_prefix is None:
+        name_prefix = os.environ.get("BENCH_ROOFLINE_ONLY") or None
     if contracts is None:
         from ..analysis.registry import default_contracts
 
         contracts = default_contracts()
+    if name_prefix:
+        contracts = [c for c in contracts if c.name.startswith(name_prefix)]
     block: dict = {}
     for c in contracts:
         entry: dict = {}
