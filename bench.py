@@ -70,7 +70,11 @@ DELIVERY_MODE = "exact"
 # 15 KB-payload bounded run) neither masks nor falsely trips a regression
 # against the light pre-r05 configs, and a mode flip (bounded -> exact)
 # starts a fresh bucket
-BENCH_CONFIG = f"n{N_PEERS}-r{HB_ROUNDS}-m{MESSAGES}-{DELIVERY_MODE}"
+# the "-dht" suffix keys the cross-protocol probe into the per-config
+# tripwire: a run that also builds the poisoned DHT and times the
+# DHT-backed recovery window opens its own comparison bucket instead of
+# comparing against pre-DHT artifacts of the same workload shape
+BENCH_CONFIG = f"n{N_PEERS}-r{HB_ROUNDS}-m{MESSAGES}-{DELIVERY_MODE}-dht"
 
 
 def attribution_split(
@@ -502,6 +506,88 @@ def main() -> None:
         f"{att_share_repair} across the repair window")
     assert np.isfinite(repair_trials_per_s) and repair_trials_per_s > 0.0
 
+    # cross-protocol DHT probe (ops/dht_adversary.py): build the poisoned
+    # DHT under the SAME sybil cohort (lookup eclipse + one rtable insert
+    # wave), derive the discovery shortlist pool, and time one DHT-backed
+    # recovery window from the post-attack state — dht_attack_trials_per_s.
+    # Pre-emit gates mirror the attack/repair probes: a probe that measured
+    # a disarmed or broken substrate must not ship a number.
+    from dst_libp2p_test_node_tpu.ops.dht_adversary import (
+        DhtAdversaryParams, build_attacked_dht, dht_repair_pool,
+        rtable_poison_budget, rtable_poison_frac,
+    )
+    from dst_libp2p_test_node_tpu.ops.repair import run_dht_recovery_heartbeats
+
+    dht = DhtAdversaryParams(lookup_eclipse=True, rtable_poison=True,
+                             warmup_waves=1, lookup_rounds=2)
+    kstate, directory = build_attacked_dht(
+        N_PEERS, seed=0, dht=dht, attacker=att, victim=4, stage=stage,
+        lat_ms=lat)
+    # reference build: same seed and eclipse, poison wave OFF. Attackers
+    # are real peers (organic table share) and the eclipsed warmup itself
+    # infects tables, so the gate bounds only the EXCESS the insert wave
+    # added — the one thing the closed-form occupancy budget prices
+    kstate_b, _ = build_attacked_dht(
+        N_PEERS, seed=0,
+        dht=DhtAdversaryParams(lookup_eclipse=True, warmup_waves=1,
+                               lookup_rounds=2),
+        attacker=att, victim=4, stage=stage, lat_ms=lat)
+    pfrac = rtable_poison_frac(kstate, att)
+
+    def _att_entries(ks):
+        rt = np.asarray(ks.rtable)[~att]
+        occ = rt >= 0
+        return int(att[np.clip(rt, 0, None)][occ].sum())
+
+    # the budget denominates over FULL table capacity (B*K slots), so the
+    # gate compares the capacity-normalized excess entry count — the
+    # occupied-share pfrac above is the reported campaign channel, not the
+    # budget's unit (sparse tables would inflate it)
+    n_honest = int((~att).sum())
+    poison_excess = ((_att_entries(kstate) - _att_entries(kstate_b))
+                     / (n_honest * dht.n_buckets * dht.k_bucket))
+    poison_budget = rtable_poison_budget(
+        dht.poison_per_peer, dht.n_buckets, dht.k_bucket)
+    assert 0.0 < poison_excess <= poison_budget, (
+        f"rtable poison excess {poison_excess:.4f} outside (0, "
+        f"{poison_budget:.4f}]: the insert wave is disarmed or exceeded "
+        "its closed-form occupancy ceiling; the probe params are wrong")
+    pool_d, _ = dht_repair_pool(kstate, dht, stage, lat, attacker=att_j,
+                                directory=directory)
+    # honest-lookup success floor: the HEALED self-lookup (the repair
+    # controller's honest walk over the same evolved tables) must hand
+    # nearly every honest peer at least one dial candidate — a substrate
+    # whose lookups come back empty would time a no-op redial path
+    pool_h, _ = dht_repair_pool(kstate, dht, stage, lat, attacker=att_j,
+                                directory=directory, healed=True)
+    honest = ~att
+    lookup_hits = float(
+        (np.asarray(pool_h)[honest] >= 0).any(axis=1).mean())
+    assert lookup_hits >= 0.9, (
+        f"honest lookup success {lookup_hits:.2f} < 0.9: the healed "
+        "self-lookup left honest peers without dial candidates; "
+        "dht_attack_trials_per_s would time a broken walk")
+
+    def _dht_trial():
+        return run_dht_recovery_heartbeats(
+            s_a, a["conns"], a["rev"], a["out_mask"], att_j, params_repair,
+            REPAIR_HB, dht_pool=pool_d, publisher=4)
+
+    (_, cn_d, *_), obs_d = _dht_trial()
+    jax.block_until_ready(cn_d)                     # compile
+    dht_s = np.inf
+    for _ in range(3):
+        t1 = time.time()
+        (_, cn_d, *_), obs_d = _dht_trial()
+        jax.block_until_ready(cn_d)
+        dht_s = min(dht_s, time.time() - t1)
+    dht_attack_trials_per_s = 1.0 / dht_s
+    pool_left = np.asarray(obs_d["dht_pool_left"])
+    assert pool_left[-1] <= pool_left[0], (
+        "dht_pool_left grew across the recovery window: the consume-on-"
+        "examine contract broke and the probe timed a no-op pool")
+    assert np.isfinite(dht_attack_trials_per_s) and dht_attack_trials_per_s > 0.0
+
     rounds = MESSAGES * per_burst
     value = N_PEERS * rounds / wall
     # coverage and percentiles over ALL timed messages, not the last one's
@@ -637,6 +723,21 @@ def main() -> None:
                 "mesh_evictions_total": evictions_total,
                 "redials_total": redials_total,
                 "attacker_mesh_share_after": round(att_share_repair, 4),
+            },
+            # cross-protocol DHT probe: one DHT-backed recovery window
+            # (poisoned discovery shortlist feeding the re-dial path) from
+            # the post-attack state, min-of-3 trials; the poison numbers
+            # are the pre-emit gate inputs (excess over the benign build,
+            # bounded by the closed-form occupancy budget)
+            "dht_attack_trials_per_s": round(dht_attack_trials_per_s, 3),
+            "dht": {
+                "recovery_heartbeats": REPAIR_HB,
+                "trial_s": round(dht_s, 3),
+                "rtable_poison_frac": round(pfrac, 4),
+                "rtable_poison_excess": round(poison_excess, 4),
+                "rtable_poison_budget": round(poison_budget, 4),
+                "honest_lookup_success": round(lookup_hits, 4),
+                "pool_left_final": float(pool_left[-1]),
             },
             "p50_ms": float(np.percentile(delays[ok], 50)),
             "p99_ms": float(np.percentile(delays[ok], 99)),

@@ -60,6 +60,11 @@ The registered surface mirrors the BENCH hot paths exactly:
                           in/out_shardings over the full trials x peers
                           grid (2 groups x remaining devices per group),
                           peer rows partitioned inside each trial group
+  campaign/dht_attack_window
+                          the cross-protocol recovery window
+                          (ops/dht_adversary.py): repair armed, per-trial
+                          poisoned discovery shortlists sharded over the
+                          same nested grid and consumed by the redial path
 """
 
 from __future__ import annotations
@@ -236,6 +241,47 @@ def _nested_attack_spec() -> TraceSpec:
         args=(stacked, shared, att),
         kwargs=dict(params=params, adv=AdversaryParams(), steps=3,
                     trial_mesh=mesh, local_trials=local))
+
+
+def _dht_attack_window_spec() -> TraceSpec:
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.adversary import attacker_cohort
+    from ..ops.dht_adversary import (DhtAdversaryParams, build_attacked_dht,
+                                     dht_repair_pool)
+    from ..parallel.sharding import make_trial_mesh
+    from ..runtime.campaign import sharded_dht_recovery_window
+
+    # repair ARMED (no strip_repair): the DHT window exists to feed the
+    # redial path a poisoned shortlist, so the audited program is the one
+    # with the repair leaves live in the carry
+    g, params, state, a, (stage, lat, bw) = _single_topic(**_REPAIR)
+    groups = 2 if len(jax.devices()) >= 2 else 1
+    mesh = make_trial_mesh(groups)
+    local = 2
+    trials = groups * local
+    stacked = jax.tree_util.tree_map(
+        lambda x: jnp.stack([jnp.asarray(x)] * trials), state)
+    dht = DhtAdversaryParams(lookup_eclipse=True, warmup_waves=1,
+                             lookup_rounds=2)
+    atts, pools = [], []
+    for s in range(trials):
+        att_np = attacker_cohort(params.n, 0.25, seed=s)
+        kstate, directory = build_attacked_dht(
+            params.n, seed=s, dht=dht, attacker=att_np, victim=3,
+            stage=stage, lat_ms=lat)
+        pool, _ = dht_repair_pool(
+            kstate, dht, stage, lat, attacker=jnp.asarray(att_np),
+            directory=directory)
+        atts.append(jnp.asarray(att_np))
+        pools.append(pool)
+    shared = {k: a[k] for k in ("conns", "rev", "out_mask")}
+    return TraceSpec(
+        fn=sharded_dht_recovery_window,
+        args=(stacked, shared, None, jnp.stack(atts), jnp.stack(pools)),
+        kwargs=dict(rparams=params, steps=3, publisher=3, trial_mesh=mesh,
+                    local_trials=local))
 
 
 def _telemetry_spec() -> TraceSpec:
@@ -550,6 +596,27 @@ def default_contracts() -> list[EntrypointContract]:
                   "rows split over each group's submesh via explicit "
                   "in/out_shardings; same aval-stability and loop/carry "
                   "bars as the legacy baseline"),
+        EntrypointContract(
+            name="campaign/dht_attack_window",
+            build=_dht_attack_window_spec,
+            expected_conds=None,
+            # the carry is (state, conns, rev, out_mask, pool): the state
+            # feeds the next window's state slot and the consumed pool the
+            # pool slot (the heal leg over stacked graphs is a separate
+            # call form, not this entrypoint's feedback)
+            feedback=[(lambda out: out[0][0], _state_arg_of),
+                      (lambda out: out[0][4], lambda spec: spec.args[4])],
+            # explicit in/out_shardings force a fresh jit closure per
+            # window: one compile per call by construction (the second
+            # heal leg traces its OWN closure over stacked graphs — a
+            # separate entrypoint, not a retrace of this one)
+            retrace_budget=1,
+            notes="the cross-protocol recovery window: repair leaves LIVE "
+                  "(the poisoned shortlist feeds the redial path), the "
+                  "(T, N, K) discovery pools shard over both grid axes and "
+                  "ride the scan carry; aval-stability across windows is "
+                  "the bar — the heal leg must reuse the same program "
+                  "shape with only the pool contents changed"),
         EntrypointContract(
             name="telemetry/recorded_heartbeats",
             build=_telemetry_spec,

@@ -398,6 +398,31 @@ def cmd_attack(argv: list[str]) -> int:
                    help="heartbeat rounds [A, B) of the latency spike")
     p.add_argument("--spike-ms", type=float, default=0.0,
                    help="extra uplink serialization delay per spiked peer")
+    # cross-protocol DHT adversary (ops/dht_adversary.py): poison the
+    # discovery layer, let the repair controller's redial path draw its
+    # candidates from the (possibly attacked) DHT instead of random peers
+    p.add_argument("--dht-eclipse", action="store_true",
+                   help="lookup eclipse: attacker responders answer "
+                   "FIND_NODE with sybil-only shortlists")
+    p.add_argument("--dht-poison", action="store_true",
+                   help="routing-table poisoning: sybil insert waves squat "
+                   "honest bucket slots")
+    p.add_argument("--dht-cluster", action="store_true",
+                   help="sybil key clustering: mint attacker keys inside "
+                   "the victim's keyspace prefix")
+    p.add_argument("--dht-heal-hb", type=int, default=-1, metavar="HB",
+                   help="recovery heartbeat at which the DHT heals (the "
+                   "redial pool switches to honest lookups); -1 = never")
+    p.add_argument("--dht-poison-per-peer", type=int, default=8,
+                   help="sybil insert attempts per honest routing table")
+    p.add_argument("--dht-cluster-prefix-bits", type=int, default=16,
+                   help="shared victim-prefix bits of minted sybil keys")
+    p.add_argument("--dht-evict-max-fails", type=int, default=1,
+                   help="failed lookups a routing-table entry survives "
+                   "before eviction (retry budget)")
+    p.add_argument("--dht-evict-backoff-ms", type=float, default=0.0,
+                   help="exponential backoff base between retries of a "
+                   "failing routing-table entry")
     # trial supervisor (SupervisorConfig): timeout + bounded retry/backoff
     p.add_argument("--trial-timeout-s", type=float, default=0.0,
                    help="wall-clock ceiling per trial batch attempt "
@@ -424,6 +449,7 @@ def cmd_attack(argv: list[str]) -> int:
             p.error(f"{flag} must be A:B heartbeat indices, got {spec!r}")
 
     from .ops.adversary import AdversaryParams
+    from .ops.dht_adversary import DhtAdversaryParams
     from .ops.faults import FaultParams
     from .ops.repair import RepairParams
     from .runtime.campaign import (
@@ -471,6 +497,15 @@ def cmd_attack(argv: list[str]) -> int:
             spike_frac=a.spike_frac,
             spike_window=_window(a.spike_window, "--spike-window"),
             spike_ms=a.spike_ms),
+        dht=DhtAdversaryParams(
+            lookup_eclipse=a.dht_eclipse,
+            rtable_poison=a.dht_poison,
+            sybil_cluster=a.dht_cluster,
+            heal_hb=a.dht_heal_hb,
+            poison_per_peer=a.dht_poison_per_peer,
+            cluster_prefix_bits=a.dht_cluster_prefix_bits,
+            evict_max_fails=a.dht_evict_max_fails,
+            evict_backoff_ms=a.dht_evict_backoff_ms),
         supervisor=SupervisorConfig(
             trial_timeout_s=a.trial_timeout_s,
             max_retries=a.max_retries,

@@ -1,0 +1,321 @@
+"""DHT adversary cohorts: poison the discovery layer, starve the mesh.
+
+GossipSub's attack-resilience story (arXiv:2007.02754) assumes a healthy
+discovery layer feeding the mesh fresh peers; pub/sub-at-scale systems
+(Topiary, arXiv:2312.06800) show discovery is the actual soft underbelly.
+This module is the Kademlia-side mirror of ops/adversary.py: three attack
+families as compiled mask/key transforms over ops/kad.KadState, composed by
+the campaign machinery (runtime/campaign.py) with the GossipSub attack
+window so one sweep answers "when the lookup layer is adversarial, how long
+does the mesh take to heal?".
+
+Attack families (all combinable, armed per-flag on DhtAdversaryParams):
+
+  lookup eclipse     attacker origins answer FIND_NODE with a poisoned
+                     shortlist drawn from a coordinated SYBIL DIRECTORY —
+                     the attacker cohort's ids ranked closest to the victim
+                     key by construction. The poison rides the python-level
+                     hook in ops/kad._find_node_impl: the benign lookup's
+                     traced program is untouched.
+  rtable poisoning   sybil inserts squat bucket slots via kad.rtable_insert
+                     (first-come-keep is the reference's LRU-without-ping
+                     policy — squatting is free). `rtable_poison_budget`
+                     gives the closed-form per-bucket occupancy ceiling the
+                     measured poison fraction must respect.
+  sybil clustering   attacker node keys are re-minted inside the victim's
+                     keyspace prefix, so xor_bitlen ranks them into the
+                     victim's tightest buckets and every honest lookup near
+                     the victim walks straight into the cohort.
+
+Arming idiom (ops/faults.py / ops/telemetry.py): the params dataclass is
+frozen/hashable, cohort material is drawn host-side from seeded
+SeedSequences (zero device PRNG), and every disabled path literally
+delegates to the existing runner — same jit cache entry, bit-identical.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import kad
+from .kad import K_RESP, KEY_WORDS, KadState, _find_node_impl
+
+
+@dataclass(frozen=True)
+class DhtAdversaryParams:
+    """DHT-layer adversary + discovery wiring knobs (frozen => hashable =>
+    usable as a jit static argument, like AdversaryParams/FaultParams).
+
+    `discovery` arms the benign wiring alone: mesh repair's re-dial path
+    draws candidates from a (healthy) find_node shortlist when the PX pool
+    is exhausted. The three attack flags each imply the wiring (an attacked
+    DHT that nothing reads would measure nothing), so `enabled` is the
+    union. All defaults OFF: DhtAdversaryParams() composes into a campaign
+    as a no-op and the campaign delegates to the pre-DHT runners."""
+
+    discovery: bool = False        # DHT-backed re-dial candidates (benign)
+    lookup_eclipse: bool = False   # poisoned FIND_NODE responses
+    rtable_poison: bool = False    # sybil bucket-slot squatting
+    sybil_cluster: bool = False    # attacker keys minted near the victim
+    # sybil inserts pushed into every peer's table (rtable_poison)
+    poison_per_peer: int = 8
+    # shared key prefix length, bits, for sybil_cluster key minting
+    cluster_prefix_bits: int = 16
+    # recovery-window round at which the DHT heals (attacked lookups give
+    # way to honest ones for the remaining rounds); -1 = never heals
+    heal_hb: int = -1
+    # sybil directory width for the eclipse response (K_RESP is plenty;
+    # wider only pads)
+    directory_size: int = 64
+    # campaign-side KadState shape: small buckets keep the (N, B, K) tables
+    # affordable at campaign N (three such arrays ride the state)
+    n_buckets: int = 16
+    k_bucket: int = 8
+    bootstraps: int = 2
+    # benign self-lookup waves that populate tables before the attack
+    warmup_waves: int = 2
+    # lookup depth for warmup and repair-pool lookups
+    lookup_rounds: int = 3
+    # kad.evict_failed retry budget for campaign-side waves (satellite:
+    # one failed round must not evict for free)
+    evict_max_fails: int = 1
+    evict_backoff_ms: float = 0.0
+
+    @property
+    def attacked(self) -> bool:
+        return self.lookup_eclipse or self.rtable_poison or self.sybil_cluster
+
+    @property
+    def enabled(self) -> bool:
+        return self.discovery or self.attacked
+
+    def validate(self) -> None:
+        if self.poison_per_peer < 1:
+            raise ValueError("poison_per_peer must be >= 1")
+        if not (0 <= self.cluster_prefix_bits <= 32 * KEY_WORDS):
+            raise ValueError("cluster_prefix_bits outside [0, KEY_BITS]")
+        if self.directory_size < 1:
+            raise ValueError("directory_size must be >= 1")
+        if self.n_buckets < 1 or self.k_bucket < 1:
+            raise ValueError("n_buckets/k_bucket must be >= 1")
+        if self.bootstraps < 1:
+            raise ValueError("bootstraps must be >= 1")
+        if self.warmup_waves < 1:
+            raise ValueError("warmup_waves must be >= 1")
+        if self.lookup_rounds < 1:
+            raise ValueError("lookup_rounds must be >= 1")
+        if self.evict_max_fails < 1:
+            raise ValueError("evict_max_fails must be >= 1")
+        if self.evict_backoff_ms < 0.0:
+            raise ValueError("evict_backoff_ms must be >= 0")
+
+
+# ------------------------------------------------------------------ cohorts
+
+
+def mint_sybil_keys(keys: np.ndarray, attacker: np.ndarray, victim: int,
+                    prefix_bits: int, seed: int) -> np.ndarray:
+    """Sybil key clustering: re-mint every attacker's node key inside the
+    victim's keyspace prefix (top `prefix_bits` bits copied from the victim,
+    the rest uniform). xor_bitlen then ranks the cohort into the victim's
+    tightest buckets — the classic keyspace-squatting placement. Pure
+    host-side numpy on a fresh SeedSequence lane (zero device PRNG)."""
+    rng = np.random.default_rng(np.random.SeedSequence([seed, 0x5B11]))
+    out = keys.copy()
+    att = np.nonzero(attacker)[0]
+    if att.size == 0 or prefix_bits == 0:
+        return out
+    rand = rng.integers(0, 1 << 32, size=(att.size, KEY_WORDS),
+                        dtype=np.uint32)
+    for w in range(KEY_WORDS):
+        hi = min(32, max(0, prefix_bits - 32 * w))
+        mask = np.uint32(((0xFFFFFFFF << (32 - hi)) & 0xFFFFFFFF) if hi
+                         else 0)
+        out[att, w] = (keys[victim, w] & mask) | (rand[:, w] & ~mask)
+    return out
+
+
+def sybil_directory(keys: np.ndarray, attacker: np.ndarray, victim: int,
+                    size: int) -> np.ndarray:
+    """The eclipse cohort's coordinated answer sheet: attacker ids ordered
+    by XOR distance to the VICTIM's key (-1 padded to `size`). Every
+    attacker responder serves FIND_NODE from this directory instead of its
+    routing table, so poisoned shortlists contain zero honest entries and
+    the entries rank closest-by-construction when sybil_cluster minted the
+    keys into the victim's prefix."""
+    out = np.full((size,), -1, dtype=np.int32)
+    att = np.nonzero(attacker)[0]
+    if att.size == 0:
+        return out
+    k = min(size, att.size)
+    order = kad.true_closest(keys[att], keys[victim], k=k)
+    out[:k] = att[order].astype(np.int32)
+    return out
+
+
+def poison_candidates(n: int, attacker: np.ndarray, per_peer: int,
+                      seed: int) -> np.ndarray:
+    """(N, per_peer) sybil insert batch for rtable poisoning: each peer is
+    pushed a random sample of attacker ids (with replacement — duplicates
+    are dropped by _insert_one's within-batch dedup, modeling imperfect
+    coordination). Host-side numpy, fresh SeedSequence lane."""
+    rng = np.random.default_rng(np.random.SeedSequence([seed, 0xD47]))
+    att = np.nonzero(attacker)[0]
+    if att.size == 0:
+        return np.full((n, per_peer), -1, dtype=np.int32)
+    return rng.choice(att, size=(n, per_peer)).astype(np.int32)
+
+
+def rtable_poison_budget(per_peer: int, n_buckets: int, k_bucket: int,
+                         prefix_bits: int = 0) -> float:
+    """Closed-form ceiling on the routing-table poison fraction one insert
+    wave of `per_peer` sybils per peer can reach (the heartbeats_to_graylist
+    idiom: the budget the measured occupancy is tested against).
+
+    For uniform sybil keys, the probability a sybil lands in bucket b
+    (distance bit-length KEY_BITS - b) is 2^-(b+1), with the final bucket
+    absorbing the tail mass 2^-(B-1). Clustered keys sharing `prefix_bits`
+    top bits with the victim shift that mass: buckets shallower than the
+    prefix get zero, deeper buckets see the distribution restarted at the
+    prefix boundary. Each bucket caps at k_bucket slots; the budget is the
+    capped expected occupancy over the whole (B, K) table. An actual table
+    can only do worse: honest entries already hold slots (first-come-keep)
+    and duplicate sybils collapse."""
+    total = 0.0
+    p = min(prefix_bits, n_buckets - 1)
+    for b in range(n_buckets):
+        if b < p:
+            mass = 0.0
+        elif b == n_buckets - 1:
+            mass = 2.0 ** -(b - p)
+        else:
+            mass = 2.0 ** -(b - p + 1)
+        total += min(per_peer * mass, float(k_bucket))
+    return min(total / (n_buckets * k_bucket), 1.0)
+
+
+def rtable_poison_frac(state: KadState, attacker: np.ndarray) -> float:
+    """Measured poison fraction: share of occupied honest-row routing-table
+    slots that point at attacker ids (host-side; the campaign's
+    rtable_poison_frac report/metrics channel)."""
+    rt = np.asarray(state.rtable)
+    honest = ~np.asarray(attacker, dtype=bool)
+    rows = rt[honest]
+    occ = rows >= 0
+    total = int(occ.sum())
+    if total == 0:
+        return 0.0
+    att = np.asarray(attacker, dtype=bool)
+    return float(att[np.clip(rows, 0, None)][occ].sum() / total)
+
+
+# ----------------------------------------------------------- attacked lookup
+
+
+@partial(jax.jit, static_argnames=("rounds", "shortlist"))
+def _find_node_attacked(state, origins, targets, stage, lat_ms, attacker,
+                        directory, rounds, shortlist):
+    # the directory is a flat (D,) id list; _closest_from_table flattens
+    # its table argument, so a (1, D) view serves directly as the cohort's
+    # shared answer table
+    poison0 = jax.vmap(
+        lambda t: kad._closest_from_table(
+            directory.reshape(1, -1), state.keys, t, K_RESP)
+    )(targets)
+    return _find_node_impl(state, origins, targets, stage, lat_ms,
+                           rounds, shortlist, attacker=attacker,
+                           poison0=poison0)
+
+
+def find_node_attacked(
+    state: KadState,
+    origins: jnp.ndarray,
+    targets: jnp.ndarray,
+    stage: jnp.ndarray,
+    lat_ms: jnp.ndarray,
+    dht: DhtAdversaryParams,
+    attacker: jnp.ndarray | None = None,
+    directory: jnp.ndarray | None = None,
+    rounds: int = 6,
+    shortlist: int = 32,
+) -> tuple[kad.LookupResult, KadState]:
+    """find_node with the lookup-eclipse family armed: attacker responders
+    answer from the sybil directory. Disabled (or no cohort material)
+    literally delegates to kad.find_node — same function object, same jit
+    cache entry, bit-identical (tests/test_dht_adversary.py pins this)."""
+    if not dht.lookup_eclipse or attacker is None or directory is None:
+        return kad.find_node(state, origins, targets, stage, lat_ms,
+                             rounds=rounds, shortlist=shortlist)
+    return _find_node_attacked(state, origins, targets, stage, lat_ms,
+                               attacker, directory, rounds, shortlist)
+
+
+# ------------------------------------------------------------ campaign setup
+
+
+def build_attacked_dht(n: int, seed: int, dht: DhtAdversaryParams,
+                       attacker: np.ndarray, victim: int,
+                       stage: jnp.ndarray, lat_ms: jnp.ndarray
+                       ) -> tuple[KadState, jnp.ndarray | None]:
+    """One trial's DHT, built under attack: init (keys minted into the
+    victim's prefix when sybil_cluster), bootstrap seeding, `warmup_waves`
+    self-lookup waves (eclipsed when lookup_eclipse — discovery warmup IS
+    the infection vector), then the rtable_poison insert wave. Returns
+    (KadState, sybil directory or None). Deterministic per (seed, params):
+    checkpoint resume re-derives it instead of snapshotting the tables."""
+    has_att = bool(np.asarray(attacker).any())
+    kstate = kad.init_kad_state(n, n_buckets=dht.n_buckets,
+                                k_bucket=dht.k_bucket, seed=seed)
+    if dht.sybil_cluster and has_att:
+        keys = mint_sybil_keys(np.asarray(kstate.keys), attacker, victim,
+                               dht.cluster_prefix_bits, seed)
+        kstate = kstate.replace(keys=jnp.asarray(keys))
+    boots = jnp.arange(min(dht.bootstraps, n), dtype=jnp.int32)
+    kstate = kad.seed_bootstraps(kstate, boots)
+    directory = None
+    att_dev = None
+    if dht.lookup_eclipse and has_att:
+        directory = jnp.asarray(sybil_directory(
+            np.asarray(kstate.keys), attacker, victim, dht.directory_size))
+        att_dev = jnp.asarray(attacker)
+    origins = jnp.arange(n, dtype=jnp.int32)
+    for _ in range(dht.warmup_waves):
+        res, kstate = find_node_attacked(
+            kstate, origins, kstate.keys, stage, lat_ms, dht,
+            attacker=att_dev, directory=directory,
+            rounds=dht.lookup_rounds)
+        kstate = kad.evict_failed(kstate, origins, res.closest,
+                                  max_fails=dht.evict_max_fails,
+                                  backoff_base_ms=dht.evict_backoff_ms)
+    if dht.rtable_poison and has_att:
+        cands = poison_candidates(n, attacker, dht.poison_per_peer, seed)
+        kstate = kad.rtable_insert(kstate, origins, jnp.asarray(cands))
+    return kstate, directory
+
+
+def dht_repair_pool(kstate: KadState, dht: DhtAdversaryParams,
+                    stage: jnp.ndarray, lat_ms: jnp.ndarray,
+                    attacker: jnp.ndarray | None = None,
+                    directory: jnp.ndarray | None = None,
+                    healed: bool = False
+                    ) -> tuple[jnp.ndarray, KadState]:
+    """The repair controller's second candidate source: every peer runs a
+    FIND_NODE self-lookup over the (possibly attacked) DHT and dials from
+    the resulting (N, K_RESP) shortlist when its PX pool is exhausted
+    (ops/repair.repair_round's dht_pool). `healed=True` forces the honest
+    lookup — the heal-after-eclipse leg — over the SAME evolved tables, so
+    residual rtable poison still shows through the honest walk."""
+    n = kstate.rtable.shape[0]
+    origins = jnp.arange(n, dtype=jnp.int32)
+    res, kstate = find_node_attacked(
+        kstate, origins, kstate.keys, stage, lat_ms, dht,
+        attacker=None if healed else attacker,
+        directory=None if healed else directory,
+        rounds=dht.lookup_rounds)
+    pool = jnp.where(res.closest == origins[:, None], -1, res.closest)
+    return pool, kstate

@@ -98,7 +98,13 @@ def _dist(keys: jnp.ndarray, entries: jnp.ndarray, target_key: jnp.ndarray):
 @struct.dataclass
 class KadState:
     """Device-side DHT state (a jax pytree). keys are per-epoch constants but
-    ride along so every op is self-contained."""
+    ride along so every op is self-contained.
+
+    rt_fails/rt_retry_ms shadow the routing table slot-for-slot: the
+    per-entry dial-failure count and the sim-ms deadline before the entry
+    may be re-dialed (exponential backoff). Both stay all-zero unless
+    `evict_failed` runs with a retry budget (max_fails > 1), so the default
+    eviction path is unchanged."""
 
     rtable: jnp.ndarray      # (N, B, K) int32, -1 empty
     keys: jnp.ndarray        # (N, W) uint32
@@ -107,6 +113,8 @@ class KadState:
     key: jnp.ndarray         # PRNG key
     queries_tx: jnp.ndarray  # (N,) int32 FIND_NODE requests sent
     queries_rx: jnp.ndarray  # (N,) int32 FIND_NODE requests served
+    rt_fails: jnp.ndarray    # (N, B, K) int32 failed dials per table entry
+    rt_retry_ms: jnp.ndarray  # (N, B, K) float32 backoff deadline per entry
 
 
 def init_kad_state(
@@ -120,6 +128,8 @@ def init_kad_state(
         key=jax.random.PRNGKey(seed ^ 0x6AD),
         queries_tx=jnp.zeros((n,), jnp.int32),
         queries_rx=jnp.zeros((n,), jnp.int32),
+        rt_fails=jnp.zeros((n, n_buckets, k_bucket), jnp.int32),
+        rt_retry_ms=jnp.zeros((n, n_buckets, k_bucket), jnp.float32),
     )
 
 
@@ -258,29 +268,25 @@ class LookupResult:
     n_queries: jnp.ndarray   # (Q,) int32 total FIND_NODE requests
 
 
-@partial(jax.jit, static_argnames=("rounds", "shortlist"))
-def find_node(
+def _find_node_impl(
     state: KadState,
-    origins: jnp.ndarray,     # (Q,) int32 distinct querying peers
-    targets: jnp.ndarray,     # (Q, W) uint32 target keys
-    stage: jnp.ndarray,       # (N,) int32 topology stage per peer
-    lat_ms: jnp.ndarray,      # (S+1, S+1) float32 stage-pair latency
-    rounds: int = 6,
-    shortlist: int = 32,
+    origins: jnp.ndarray,
+    targets: jnp.ndarray,
+    stage: jnp.ndarray,
+    lat_ms: jnp.ndarray,
+    rounds: int,
+    shortlist: int,
+    attacker: jnp.ndarray | None = None,
+    poison0: jnp.ndarray | None = None,
 ) -> tuple[LookupResult, KadState]:
-    """Batched iterative FIND_NODE (kad-dht/core.nim warmup/probe primitive).
-
-    Each origin walks the XOR metric toward its target: query the ALPHA
-    closest unqueried shortlist peers, merge their K_RESP closest entries,
-    repeat `rounds` times (enough for uniform keys at any simulated N: each
-    round roughly halves the remaining distance). Per-round wall time is the
-    max RTT of the parallel queries, accumulated only while the shortlist
-    still improves — matching the iterative lookup's termination ("no peer
-    closer than the best seen" => stop counting).
-
-    Returns per-origin results plus state with updated tables (origin learns
-    every response entry; queried peers learn the origin) and counters.
-    """
+    """Shared lookup body behind find_node and the DHT adversary's attacked
+    lookup (ops/dht_adversary.find_node_attacked). The poison hook is
+    python-level: with attacker/poison0 None, the traced program is
+    IDENTICAL to the original find_node — the benign path never pays for
+    the attack machinery. Armed, every response from an attacker-controlled
+    peer is replaced wholesale by `poison0` (the (Q, K_RESP) sybil-directory
+    response per target): a lookup eclipse denies honest entries entirely
+    instead of merely biasing the merge."""
     n = state.rtable.shape[0]
     q = origins.shape[0]
     s = shortlist
@@ -320,6 +326,12 @@ def find_node(
             jnp.clip(p_ids, 0), targets
         )                                                 # (Q, ALPHA, K_RESP)
         resp = jnp.where((p_ids >= 0)[..., None], resp, -1)
+        if attacker is not None:
+            # lookup eclipse: a live attacker responder answers with the
+            # sybil directory's closest entries instead of its table
+            is_att = ((p_ids >= 0) & attacker[jnp.clip(p_ids, 0)]
+                      & state.alive[jnp.clip(p_ids, 0)])
+            resp = jnp.where(is_att[..., None], poison0[:, None, :], resp)
 
         # round RTT = max over the parallel queries (iterative lookup waits)
         rtt = 2.0 * lat_ms[o_stage[:, None], stage[jnp.clip(p_ids, 0)]] + PROC_MS
@@ -370,9 +382,37 @@ def find_node(
     return result, state
 
 
-@jax.jit
+@partial(jax.jit, static_argnames=("rounds", "shortlist"))
+def find_node(
+    state: KadState,
+    origins: jnp.ndarray,     # (Q,) int32 distinct querying peers
+    targets: jnp.ndarray,     # (Q, W) uint32 target keys
+    stage: jnp.ndarray,       # (N,) int32 topology stage per peer
+    lat_ms: jnp.ndarray,      # (S+1, S+1) float32 stage-pair latency
+    rounds: int = 6,
+    shortlist: int = 32,
+) -> tuple[LookupResult, KadState]:
+    """Batched iterative FIND_NODE (kad-dht/core.nim warmup/probe primitive).
+
+    Each origin walks the XOR metric toward its target: query the ALPHA
+    closest unqueried shortlist peers, merge their K_RESP closest entries,
+    repeat `rounds` times (enough for uniform keys at any simulated N: each
+    round roughly halves the remaining distance). Per-round wall time is the
+    max RTT of the parallel queries, accumulated only while the shortlist
+    still improves — matching the iterative lookup's termination ("no peer
+    closer than the best seen" => stop counting).
+
+    Returns per-origin results plus state with updated tables (origin learns
+    every response entry; queried peers learn the origin) and counters.
+    """
+    return _find_node_impl(state, origins, targets, stage, lat_ms,
+                           rounds, shortlist)
+
+
+@partial(jax.jit, static_argnames=("max_fails", "backoff_base_ms"))
 def evict_failed(state: KadState, origins: jnp.ndarray,
-                 found: jnp.ndarray) -> KadState:
+                 found: jnp.ndarray, max_fails: int = 1,
+                 backoff_base_ms: float = 0.0) -> KadState:
     """DISCOVERY=extended (KademliaDiscovery) eviction: the discovery layer
     exists to hand the application CONNECTABLE peers, so after the
     end-of-lookup dial-out to the FOUND peers, every dial that fails (a
@@ -383,21 +423,57 @@ def evict_failed(state: KadState, origins: jnp.ndarray,
     re-packed left so the append-position arithmetic of _insert_one stays
     valid.
 
+    Retry budget (the supervisor's backoff idiom, runtime/campaign.py):
+    with `max_fails` > 1 a failed dial does not evict immediately — the
+    entry's per-slot failure counter increments and the entry goes under
+    exponential backoff (`backoff_base_ms * 2**(fails-1)` past state.t_ms);
+    while under backoff a repeated failure is NOT re-counted (the dial was
+    never retried). Eviction fires only once the counter reaches
+    `max_fails`. A successful dial resets the counter and the deadline.
+    The default (max_fails=1) reproduces the original immediate-eviction
+    tables exactly — an attack cannot get free evictions from one lossy
+    round unless the operator opted out of retries.
+
     `found`: (Q, K) shortlist heads each origin dials
     (LookupResult.closest)."""
     dead = ~state.alive
+    t = state.t_ms
 
-    def evict_one(table, f_ids):
+    def evict_one(table, fails, retry, f_ids):
         bad_ids = jnp.where((f_ids >= 0) & dead[jnp.clip(f_ids, 0)],
                             f_ids, -2)
         is_bad = (table[..., None] == bad_ids).any(axis=-1)
-        marked = jnp.where(is_bad, -1, table)
+        good_ids = jnp.where((f_ids >= 0) & ~dead[jnp.clip(f_ids, 0)],
+                             f_ids, -2)
+        is_good = (table[..., None] == good_ids).any(axis=-1)
+        # entries under backoff were not re-dialed this wave: no new count
+        fail_event = is_bad & ~(retry > t)
+        fails = jnp.where(fail_event, fails + 1, fails)
+        fails = jnp.where(is_good, 0, fails)
+        evict = fail_event & (fails >= max_fails)
+        retry = jnp.where(
+            fail_event & ~evict,
+            t + backoff_base_ms * jnp.exp2((fails - 1).astype(jnp.float32)),
+            retry)
+        retry = jnp.where(is_good, 0.0, retry)
+        marked = jnp.where(evict, -1, table)
+        fails = jnp.where(evict, 0, fails)
+        retry = jnp.where(evict, 0.0, retry)
         # compact each bucket: keep entries left-packed, holes to the right
+        # (the shadow arrays repack with the table so slots stay aligned)
         order = jnp.argsort(marked < 0, axis=-1, stable=True)
-        return jnp.take_along_axis(marked, order, axis=-1)
+        return (jnp.take_along_axis(marked, order, axis=-1),
+                jnp.take_along_axis(fails, order, axis=-1),
+                jnp.take_along_axis(retry, order, axis=-1))
 
-    new_rows = jax.vmap(evict_one)(state.rtable[origins], found)
-    return state.replace(rtable=state.rtable.at[origins].set(new_rows))
+    new_rows, new_fails, new_retry = jax.vmap(evict_one)(
+        state.rtable[origins], state.rt_fails[origins],
+        state.rt_retry_ms[origins], found)
+    return state.replace(
+        rtable=state.rtable.at[origins].set(new_rows),
+        rt_fails=state.rt_fails.at[origins].set(new_fails),
+        rt_retry_ms=state.rt_retry_ms.at[origins].set(new_retry),
+    )
 
 
 @jax.jit

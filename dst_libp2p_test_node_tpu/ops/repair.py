@@ -108,6 +108,8 @@ def repair_round(
     params: SimParams,
     actor: jnp.ndarray | None = None,
     batch_factor: int = 1,
+    dht_pool: jnp.ndarray | None = None,
+    refuse: jnp.ndarray | None = None,
 ):
     """One round of the repair controller, applied AFTER heartbeat_step.
 
@@ -123,6 +125,19 @@ def repair_round(
       dial   an unconnected candidate — PX pool first, else (re-dial
              trigger) a uniform random known peer — filling one free slot
              on each side and grafting the fresh edge (score 0, no backoff).
+
+    `dht_pool`: optional (N, K) discovery shortlist (a FIND_NODE self-lookup,
+    ops/dht_adversary.dht_repair_pool) that REPLACES the uniform-random
+    fallback as the re-dial candidate source — the candidate-source lattice
+    becomes PX pool -> DHT shortlist -> nothing. The examined DHT entry is
+    consumed success-or-fail (like the PX pool) so a dead or refusing
+    candidate cannot wedge the controller, and the updated pool is returned
+    as a fifth result. `refuse`: optional (N,) bool of peers that never
+    accept an inbound dial (sybil identities are not connectable
+    endpoints); a starved peer whose every candidate refuses keeps its
+    starve_hb counter growing instead of wedging. Both are python-level
+    (None compiles the original program — bit-identical, same key
+    schedule).
 
     The whole action machinery runs under one lax.cond: a healthy network
     (nobody starved, no PX pending) pays only the trigger probes."""
@@ -162,9 +177,22 @@ def repair_round(
     if params.redial:
         redial_want = act & (starve >= params.redial_patience)
     use_px = px_want | (redial_want & has_cand)
-    use_rand = redial_want & ~has_cand & alive_sub[r]
-    want = use_px | use_rand
-    tgt = jnp.where(use_px, cand, jnp.where(use_rand, r, -1))
+    if dht_pool is None:
+        use_rand = redial_want & ~has_cand & alive_sub[r]
+        want = use_px | use_rand
+        tgt = jnp.where(use_px, cand, jnp.where(use_rand, r, -1))
+    else:
+        # discovery-backed re-dial: the DHT shortlist replaces the uniform
+        # random fallback entirely — a poisoned lookup measurably starves
+        # the controller instead of being papered over by ambient luck
+        d_ok = ((dht_pool >= 0) & (dht_pool != me[:, None])
+                & alive_sub[jnp.clip(dht_pool, 0)])
+        has_dcand = d_ok.any(axis=-1)
+        dk0 = jnp.argmax(d_ok, axis=-1)
+        dcand = jnp.take_along_axis(dht_pool, dk0[:, None], axis=1)[:, 0]
+        use_dht = redial_want & ~has_cand & has_dcand
+        want = use_px | use_dht
+        tgt = jnp.where(use_px, cand, jnp.where(use_dht, dcand, -1))
     tgt_c = jnp.clip(tgt, 0)
 
     def _fire(_):
@@ -193,6 +221,10 @@ def repair_round(
         # dialer never accepts in the same round — breaks the mutual-dial
         # double-edge race deterministically)
         attempt = dial_try & has_free[tgt_c] & alive_sub[tgt_c] & ~dial_try[tgt_c]
+        if refuse is not None:
+            # sybil identities never complete a handshake: the dial is
+            # attempted (and the candidate consumed) but cannot commit
+            attempt = attempt & ~refuse[tgt_c]
         # one inbound dial per acceptor per round: lowest dialer id wins
         winner = jnp.full((n,), n, dtype=jnp.int32).at[
             jnp.where(attempt, tgt_c, 0)].min(jnp.where(attempt, me, n))
@@ -241,18 +273,29 @@ def repair_round(
         pool2 = jnp.where(
             use_px[:, None] & (jnp.arange(pw)[None, :] == k0[:, None]),
             -1, pool)
-        return (mesh, backoff, fmd, slow, warm, new_conns, new_rev, new_out,
-                pool2, grafts, grafts_rx, px_grafts, redials)
+        out = (mesh, backoff, fmd, slow, warm, new_conns, new_rev, new_out,
+               pool2, grafts, grafts_rx, px_grafts, redials)
+        if dht_pool is not None:
+            # same consume-on-examine rule for the DHT shortlist
+            dw = dht_pool.shape[1]
+            dpool2 = jnp.where(
+                use_dht[:, None] & (jnp.arange(dw)[None, :] == dk0[:, None]),
+                -1, dht_pool)
+            out = out + (dpool2,)
+        return out
 
     def _skip(_):
-        return (state.mesh_mask, state.backoff_until, state.fmd,
-                state.slow_penalty, state.warm_offset_ms, conns, rev,
-                out_mask, pool, state.grafts, state.grafts_rx,
-                state.px_grafts, state.redials)
+        out = (state.mesh_mask, state.backoff_until, state.fmd,
+               state.slow_penalty, state.warm_offset_ms, conns, rev,
+               out_mask, pool, state.grafts, state.grafts_rx,
+               state.px_grafts, state.redials)
+        if dht_pool is not None:
+            out = out + (dht_pool,)
+        return out
 
+    fired = jax.lax.cond(want.any(), _fire, _skip, jnp.int32(0))
     (mesh, backoff, fmd, slow, warm, conns2, rev2, out2, pool2,
-     grafts, grafts_rx, px_grafts, redials) = jax.lax.cond(
-        want.any(), _fire, _skip, jnp.int32(0))
+     grafts, grafts_rx, px_grafts, redials) = fired[:13]
 
     new_state = state.replace(
         mesh_mask=mesh, backoff_until=backoff, fmd=fmd, slow_penalty=slow,
@@ -260,6 +303,8 @@ def repair_round(
         grafts=grafts, grafts_rx=grafts_rx,
         px_grafts=px_grafts, redials=redials,
     )
+    if dht_pool is not None:
+        return new_state, conns2, rev2, out2, fired[13]
     return new_state, conns2, rev2, out2
 
 
@@ -326,3 +371,79 @@ def run_recovery_heartbeats(
 
     return jax.lax.scan(
         body, (state, conns, rev, out_mask), None, length=steps)
+
+
+@partial(jax.jit,
+         static_argnames=("params", "steps", "publisher", "batch_factor",
+                          "telemetry"))
+def _run_dht_recovery_heartbeats(state, conns, rev, out_mask, attacker,
+                                 dht_pool, params, steps, publisher,
+                                 batch_factor, telemetry):
+    def body(carry, _):
+        s, cn, rv, om, pool = carry
+        ev0 = s.evictions.sum()
+        px0 = s.px_grafts.sum()
+        rd0 = s.redials.sum()
+        s = heartbeat_step(s, cn, rv, om, params, batch_factor=batch_factor)
+        s, cn, rv, om, pool = repair_round(
+            s, cn, rv, om, params, actor=~attacker,
+            batch_factor=batch_factor, dht_pool=pool, refuse=attacker)
+        obs = attack_observables(s, cn, rv, attacker, params,
+                                 batch_factor=batch_factor)
+        f32 = jnp.float32
+        nbr = cn[publisher]
+        att_n = (nbr >= 0) & attacker[jnp.clip(nbr, 0)]
+        obs["pub_honest_degree"] = (
+            s.mesh_mask[publisher] & (nbr >= 0) & ~att_n).sum().astype(f32)
+        obs["evictions"] = (s.evictions.sum() - ev0).astype(f32)
+        obs["px_grafts"] = (s.px_grafts.sum() - px0).astype(f32)
+        obs["redials"] = (s.redials.sum() - rd0).astype(f32)
+        obs["dht_pool_left"] = (pool >= 0).sum().astype(f32)
+        # the starvation-degradation signal: a peer whose every candidate
+        # refuses keeps counting up — the curve must climb, never wedge
+        obs["starve_max"] = s.starve_hb.max().astype(f32)
+        if telemetry is not None:
+            from .telemetry import telemetry_observables
+
+            obs.update(telemetry_observables(
+                s, cn, rv, params, telemetry, batch_factor=batch_factor))
+        return (s, cn, rv, om, pool), obs
+
+    return jax.lax.scan(
+        body, (state, conns, rev, out_mask, dht_pool), None, length=steps)
+
+
+def run_dht_recovery_heartbeats(
+    state: SimState,
+    conns: jnp.ndarray,
+    rev: jnp.ndarray,
+    out_mask: jnp.ndarray,
+    attacker: jnp.ndarray,
+    params: SimParams,
+    steps: int,
+    dht_pool: jnp.ndarray | None = None,
+    publisher: int = 0,
+    batch_factor: int = 1,
+    telemetry=None,
+):
+    """run_recovery_heartbeats with the discovery-backed candidate source:
+    the (N, K) DHT shortlist rides the scan carry and feeds repair_round's
+    re-dial path (refuse=attacker — sybil identities never accept), so a
+    poisoned lookup measurably delays recovery and an exhausted pool
+    degrades to monotone starvation instead of wedging. Returns
+    ((state, conns, rev, out_mask, dht_pool), obs) with the extra
+    `dht_pool_left` per-round channel.
+
+    `dht_pool=None` LITERALLY delegates to run_recovery_heartbeats — same
+    function object, same jit cache entry, bit-identical output shape and
+    values, zero extra PRNG (tests/test_dht_adversary.py pins this)."""
+    if dht_pool is None:
+        return run_recovery_heartbeats(
+            state, conns, rev, out_mask, attacker, params, steps,
+            publisher=publisher, batch_factor=batch_factor,
+            telemetry=telemetry)
+    if telemetry is not None and not telemetry.enabled:
+        telemetry = None
+    return _run_dht_recovery_heartbeats(
+        state, conns, rev, out_mask, attacker, dht_pool, params, steps,
+        publisher, batch_factor, telemetry)

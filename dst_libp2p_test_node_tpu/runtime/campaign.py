@@ -101,13 +101,23 @@ from ..ops.adversary import (
     heartbeats_to_graylist,
     run_attacked_heartbeats,
 )
+from ..ops.dht_adversary import (
+    DhtAdversaryParams,
+    build_attacked_dht,
+    dht_repair_pool,
+    rtable_poison_frac,
+)
 from ..ops.faults import (
     FaultParams,
     fault_masks,
     partition_edge_mask,
     run_faulted_heartbeats,
 )
-from ..ops.repair import RepairParams, run_recovery_heartbeats
+from ..ops.repair import (
+    RepairParams,
+    run_dht_recovery_heartbeats,
+    run_recovery_heartbeats,
+)
 from ..ops.telemetry import TelemetryParams
 from .simulator import ExperimentConfig, MessageRecord, Simulator
 from .summarize import sanitize_nonfinite
@@ -257,6 +267,13 @@ class CampaignConfig:
     # milestone columns to TrialResult; the default (record=False) leaves
     # every window on the exact pre-telemetry program
     telemetry: TelemetryParams = field(default_factory=TelemetryParams)
+    # DHT adversary + discovery wiring (ops/dht_adversary.py): armed, every
+    # trial builds a per-seed Kademlia state (shared attacker cohort —
+    # cross-protocol), the recovery window's re-dial path draws candidates
+    # from the possibly-attacked FIND_NODE shortlist, and dht.heal_hb
+    # splits the window into an attacked leg and a healed leg. The default
+    # (all-off) leaves every trial on the exact pre-DHT program.
+    dht: DhtAdversaryParams = field(default_factory=DhtAdversaryParams)
 
     def adversary_params(self) -> AdversaryParams:
         return self.adversary or AdversaryParams(scenario=self.scenario)
@@ -281,6 +298,20 @@ class CampaignConfig:
         self.faults.validate()
         self.supervisor.validate()
         self.telemetry.validate()
+        self.dht.validate()
+        if self.dht.enabled:
+            if self.recovery_heartbeats < 1:
+                raise ValueError(
+                    "dht arming needs recovery_heartbeats >= 1: the DHT "
+                    "candidate source only feeds the recovery window")
+            if not self.repair.redial:
+                raise ValueError(
+                    "dht arming needs repair.redial=True: the DHT shortlist "
+                    "is the re-dial path's candidate source")
+            if self.dht.heal_hb >= self.recovery_heartbeats:
+                raise ValueError(
+                    f"dht.heal_hb {self.dht.heal_hb} must fall inside the "
+                    f"recovery window ({self.recovery_heartbeats} rounds)")
         if self.faults.crash and (
                 self.faults.crash_window[1] > self.attack_heartbeats):
             # the restart edge must land inside the window or the cohort
@@ -344,6 +375,9 @@ class TrialResult:
     coverage90_hb: int = -1      # first round with tel_mesh_coverage >= 0.9
     score_cross_hb: int = -1     # first round the median live score drops
     #                              below graylist_threshold
+    # DHT adversary observables (ops/dht_adversary.py); -1 = DHT not armed
+    rtable_poison_frac: float = -1.0  # attacker share of occupied honest
+    #                                   routing-table slots, post-build
 
     def to_dict(self) -> dict:
         # strict-JSON consumers run allow_nan=False; the shared sanitizer
@@ -673,6 +707,67 @@ def sharded_recovery_window(stacked, shared: dict, attackers, rparams,
     ))(stacked, attackers, shared["conns"], shared["rev"], shared["out_mask"])
 
 
+def _run_nested_window_stacked(body, trial_mesh, n_rows: int,
+                               stacked_args: tuple):
+    """_run_nested_window for a body whose EVERY input carries a leading
+    trial axis — the second DHT recovery leg, where each trial continues
+    from its own (possibly dialed) graph arrays instead of the shared
+    epoch graph."""
+    import jax
+
+    from ..parallel.sharding import nested_batch_shardings
+
+    in_sh = tuple(
+        nested_batch_shardings(a, trial_mesh, n_rows) for a in stacked_args)
+    out_sh = nested_batch_shardings(
+        jax.eval_shape(body, *stacked_args), trial_mesh, n_rows)
+    return jax.jit(body, in_shardings=in_sh,
+                   out_shardings=out_sh)(*stacked_args)
+
+
+def sharded_dht_recovery_window(stacked, shared: dict | None, graphs,
+                                attackers, pools, rparams, steps: int,
+                                publisher: int, trial_mesh,
+                                local_trials: int, telemetry=None):
+    """The DHT-armed recovery window on the 2-D trials x peers grid: the
+    per-trial (N, K) discovery shortlists are peer-major like the attacker
+    masks, so they shard over both axes and ride the repair scan carry
+    inside each trial group. Pass `shared` (the epoch graph dict) for a
+    window starting from the shared graph, or `graphs` (stacked per-trial
+    (T, N, C) conns/rev/out_mask) for a continuation leg that resumes each
+    trial's own dialed graph — the heal-after-eclipse second leg."""
+    import jax
+
+    bf = _nested_batch_factor(trial_mesh, local_trials)
+
+    if graphs is None:
+        def body(st, at, pl, cn, rv, om):
+            def one(s, a, p):
+                return run_dht_recovery_heartbeats(
+                    s, cn, rv, om, a, rparams, steps, dht_pool=p,
+                    publisher=publisher, batch_factor=bf,
+                    telemetry=telemetry)
+
+            return jax.vmap(one)(st, at, pl)
+
+        n_rows = shared["conns"].shape[0]
+        return _run_nested_window(body, trial_mesh, n_rows,
+                                  (stacked, attackers, pools), shared)
+
+    def body2(st, at, pl, cn, rv, om):
+        def one(s, a, p, c2, r2, o2):
+            return run_dht_recovery_heartbeats(
+                s, c2, r2, o2, a, rparams, steps, dht_pool=p,
+                publisher=publisher, batch_factor=bf, telemetry=telemetry)
+
+        return jax.vmap(one)(st, at, pl, cn, rv, om)
+
+    n_rows = graphs[0].shape[1]
+    return _run_nested_window_stacked(
+        body2, trial_mesh, n_rows,
+        (stacked, attackers, pools) + tuple(graphs))
+
+
 def _unstack_trial(tree_fn, stacked_out, j: int):
     """Slice trial j out of a sharded window's stacked output and NORMALIZE
     its placement to the default device. A nested-sharded output leaf keeps
@@ -905,6 +1000,66 @@ def _recovery_windows_sharded(sim: Simulator, cfg: CampaignConfig,
     ]
 
 
+def _dht_legs(dht: DhtAdversaryParams, steps: int) -> tuple[int, int]:
+    """(attacked rounds, healed rounds) of a recovery window: dht.heal_hb
+    splits the window at the heal edge; -1 = the DHT never heals."""
+    if dht.heal_hb < 0:
+        return steps, 0
+    return dht.heal_hb, steps - dht.heal_hb
+
+
+def _dht_recovery_windows_sharded(sim: Simulator, cfg: CampaignConfig,
+                                  states: list, attackers: list,
+                                  pools_a: list, pools_b: list, pub: int,
+                                  trial_mesh, telemetry=None):
+    """The DHT-armed analog of _recovery_windows_sharded: one nested window
+    per leg (attacked, then healed), the second leg resuming each trial's
+    own dialed graph arrays; obs legs concatenate along the round axis so
+    recovery_time_ms is measured over the whole window."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..parallel.sharding import place_trial_batch
+
+    tree = jax.tree_util.tree_map
+    t_count = len(states)
+    steps1, steps2 = _dht_legs(cfg.dht, cfg.recovery_heartbeats)
+    pairs = list(zip(pools_a, pools_b))
+    states, attackers, pairs, local = _pad_to_groups(
+        states, attackers, trial_mesh, extras=pairs)
+    stacked = tree(lambda *xs: jnp.stack(xs), *states)
+    att = jnp.stack(attackers)
+    (stacked, att), shared = place_trial_batch(
+        (stacked, att), sim.arrays, trial_mesh, n_rows=sim.params.n)
+    rparams = cfg.repair.apply(sim.params)
+    obs_legs = []
+    cur_state, cur_graphs = stacked, None
+    if steps1 > 0:
+        pa = jnp.stack([p[0] for p in pairs])
+        (st, cn, rv, om, _pool), obs1 = sharded_dht_recovery_window(
+            cur_state, shared, None, att, pa, rparams, steps1, pub,
+            trial_mesh, local, telemetry=telemetry)
+        cur_state, cur_graphs = st, (cn, rv, om)
+        obs_legs.append(obs1)
+    if steps2 > 0:
+        pb = jnp.stack([p[1] for p in pairs])
+        (st, cn, rv, om, _pool), obs2 = sharded_dht_recovery_window(
+            cur_state, shared if cur_graphs is None else None, cur_graphs,
+            att, pb, rparams, steps2, pub, trial_mesh, local,
+            telemetry=telemetry)
+        cur_state, cur_graphs = st, (cn, rv, om)
+        obs_legs.append(obs2)
+    obs_np = (tree(np.asarray, obs_legs[0]) if len(obs_legs) == 1 else
+              tree(lambda *xs: np.concatenate(
+                  [np.asarray(x) for x in xs], axis=1), *obs_legs))
+    outs = (cur_state,) + cur_graphs
+    return [
+        (_unstack_trial(tree, outs, j),
+         {k: v[j] for k, v in obs_np.items()})
+        for j in range(t_count)
+    ]
+
+
 def _attacked_trials(
     sim: Simulator,
     cfg: CampaignConfig,
@@ -985,12 +1140,46 @@ def _attacked_trials(
     # the dial controller can mutate the graph arrays per trial; keep the
     # epoch graph to restore before the next trial's reset
     epoch_arrays = dict(sim.arrays)
+    # cross-protocol setup: one per-seed Kademlia state built under the
+    # SHARED attacker cohort (the same node ids attack both layers), plus
+    # the pre-computed repair-pool shortlists for each window leg. Host
+    # work + a few device lookups — deterministic per (seed, dht), so
+    # checkpoint resume re-derives instead of snapshotting.
+    dht_on = cfg.dht.enabled and cfg.recovery_heartbeats > 0
+    steps1, steps2 = _dht_legs(cfg.dht, cfg.recovery_heartbeats)
+    kad_ctx: dict[int, tuple] = {}
+    if dht_on:
+        for s in seeds:
+            att_np, att_dev = cohorts[s]
+            kstate, directory = build_attacked_dht(
+                n, seed=s, dht=cfg.dht, attacker=att_np, victim=pub,
+                stage=sim._stage, lat_ms=sim._lat)
+            pfrac = rtable_poison_frac(kstate, att_np)
+            pool_a = pool_b = None
+            if steps1 > 0:
+                pool_a, kstate = dht_repair_pool(
+                    kstate, cfg.dht, sim._stage, sim._lat,
+                    attacker=att_dev, directory=directory)
+            if steps2 > 0:
+                pool_b, kstate = dht_repair_pool(
+                    kstate, cfg.dht, sim._stage, sim._lat,
+                    attacker=att_dev, directory=directory, healed=True)
+            kad_ctx[s] = (kstate, pool_a, pool_b, pfrac)
     recov = None
     if (cfg.recovery_heartbeats > 0 and trial_mesh is not None
             and len(seeds) > 1):
-        recov = _recovery_windows_sharded(
-            sim, cfg, [state_by_seed[s] for s in seeds],
-            [cohorts[s][1] for s in seeds], pub, trial_mesh, telemetry=tel)
+        if dht_on:
+            recov = _dht_recovery_windows_sharded(
+                sim, cfg, [state_by_seed[s] for s in seeds],
+                [cohorts[s][1] for s in seeds],
+                [kad_ctx[s][1] for s in seeds],
+                [kad_ctx[s][2] for s in seeds], pub, trial_mesh,
+                telemetry=tel)
+        else:
+            recov = _recovery_windows_sharded(
+                sim, cfg, [state_by_seed[s] for s in seeds],
+                [cohorts[s][1] for s in seeds], pub, trial_mesh,
+                telemetry=tel)
     out = []
     for j, s in enumerate(seeds):
         att, att_j = cohorts[s]
@@ -1011,7 +1200,8 @@ def _attacked_trials(
 
             os.makedirs(cfg.checkpoint_dir, exist_ok=True)
             ck, sc = _trial_ckpt(cfg, fraction, s)
-            save_checkpoint(sim, ck)
+            save_checkpoint(
+                sim, ck, kad_state=kad_ctx[s][0] if dht_on else None)
             # obs sidecar: the engagement/recovery curves span the attack
             # window the checkpoint already paid for — without them a
             # resumed trial could restore the state but not its metrics
@@ -1030,6 +1220,27 @@ def _attacked_trials(
 
             if recov is not None:
                 (st2, cn2, rv2, om2), robs = recov[j]
+            elif dht_on:
+                # two-leg window: attacked pool, then (optionally) healed
+                # pool resuming the same trial's dialed graph
+                rparams = cfg.repair.apply(sim.params)
+                a = sim.arrays
+                _, pool_a, pool_b, _ = kad_ctx[s]
+                st2, cn2, rv2, om2 = (sim.state, a["conns"], a["rev"],
+                                      a["out_mask"])
+                leg_obs = []
+                for leg_steps, pool in ((steps1, pool_a),
+                                        (steps2, pool_b)):
+                    if leg_steps <= 0:
+                        continue
+                    carry, lobs = run_dht_recovery_heartbeats(
+                        st2, cn2, rv2, om2, att_j, rparams, leg_steps,
+                        dht_pool=pool, publisher=pub, telemetry=tel)
+                    st2, cn2, rv2, om2 = carry[:4]
+                    leg_obs.append(lobs)
+                robs = jax.tree_util.tree_map(
+                    lambda *xs: np.concatenate(
+                        [np.asarray(x) for x in xs], axis=0), *leg_obs)
             else:
                 rparams = cfg.repair.apply(sim.params)
                 a = sim.arrays
@@ -1048,6 +1259,11 @@ def _attacked_trials(
             obs_j = {k: (np.concatenate(
                 [np.asarray(obs_j[k]), np.asarray(robs[k])])
                 if k in robs else np.asarray(obs_j[k])) for k in obs_j}
+            if dht_on:
+                # host-side channel: constant over the window, but shaped
+                # like a curve so sidecars/reports treat it uniformly
+                obs_j["rtable_poison_frac"] = np.full(
+                    cfg.recovery_heartbeats, kad_ctx[s][3], np.float32)
             rec_ok = ((robs["attacker_mesh_share"]
                        <= cfg.mesh_recovery_share)
                       & (robs["pub_honest_degree"] >= 1.0))
@@ -1132,6 +1348,7 @@ def _attacked_trials(
             coverage_under_partition=cov_part,
             coverage90_hb=cov90_hb,
             score_cross_hb=score_cross_hb,
+            rtable_poison_frac=(kad_ctx[s][3] if dht_on else -1.0),
         ))
         if cfg.recovery_heartbeats > 0 and not graph_static:
             # restore the epoch graph: the next trial (and _reset_trial's

@@ -36,7 +36,13 @@ from ..config.env import GossipSubParams
 from ..config.topology import Topology, TopoParams
 from .simulator import ExperimentConfig, MessageRecord, Simulator
 
-FORMAT_VERSION = 8  # bump on any SimState layout change (v8: mesh-repair
+FORMAT_VERSION = 9  # bump on any SimState layout change (v9: optional
+#                     kad/* leaves — a campaign snapshot taken with the DHT
+#                     adversary armed embeds the per-trial KadState so the
+#                     poisoned routing tables are auditable offline; the
+#                     loader IGNORES them (campaign resume re-derives the
+#                     DHT deterministically from (seed, dht config)), so
+#                     v8 snapshots load unchanged; v8: mesh-repair
 #                     leaves px_pool/starve_hb/evictions/px_grafts/redials —
 #                     older snapshots load with an empty PX pool and zeroed
 #                     repair counters, exactly a fresh run's repair state;
@@ -103,8 +109,14 @@ def _records_from_arrays(z) -> list[MessageRecord]:
     ]
 
 
-def save_checkpoint(sim: Simulator, path: str) -> None:
-    """Snapshot a Simulator to `path` (.npz)."""
+def save_checkpoint(sim: Simulator, path: str, kad_state=None) -> None:
+    """Snapshot a Simulator to `path` (.npz).
+
+    `kad_state`: optional ops.kad.KadState. Campaign trials running with
+    the DHT adversary armed pass their per-trial Kademlia state so the
+    poisoned routing tables travel with the snapshot (offline audit,
+    `rtable_poison_frac` recomputation). Resume does NOT read these
+    leaves — the campaign re-derives the DHT from (seed, dht config)."""
     from flax import serialization
 
     meta = {
@@ -129,6 +141,9 @@ def save_checkpoint(sim: Simulator, path: str) -> None:
     for k in _TOPO_KEYS:
         arrays[f"topo/{k}"] = np.asarray(getattr(topo, k))
     arrays.update(_records_arrays(sim.records))
+    if kad_state is not None:
+        for k, v in serialization.to_state_dict(kad_state).items():
+            arrays[f"kad/{k}"] = np.asarray(v)
     # atomic replace: a crash mid-write (the exact event checkpoints exist
     # to survive) must not truncate the previous good snapshot
     tmp = f"{path}.tmp"
@@ -147,11 +162,11 @@ def load_checkpoint(path: str, mesh=None) -> Simulator:
 
     z = np.load(path)
     meta = json.loads(bytes(z["meta_json"]).decode())
-    if meta["version"] not in (5, 6, 7, FORMAT_VERSION):
-        # v5..v7 differ only by absent leaves with safe fresh-run defaults:
+    if meta["version"] not in (5, 6, 7, 8, FORMAT_VERSION):
+        # v5..v8 differ only by absent leaves with safe fresh-run defaults:
         # per-record answer_wait (record reader), the warm-start carry
-        # (INF below), and the mesh-repair leaves (empty pool / zero
-        # counters below) — accept all four
+        # (INF below), the mesh-repair leaves (empty pool / zero
+        # counters below), and v9's write-only kad/* extras — accept all
         raise ValueError(
             f"checkpoint format {meta['version']} != supported {FORMAT_VERSION}"
         )

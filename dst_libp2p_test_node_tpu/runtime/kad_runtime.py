@@ -44,6 +44,12 @@ class KadConfig:
     topo: TopoParams | None = None
     n_buckets: int = 24
     k_bucket: int = 16
+    # extended-mode dial-failure handling (ops/kad.evict_failed): a routing
+    # entry survives `evict_max_fails - 1` failed dials, with exponential
+    # backoff between retries, before it is evicted. The default (1, 0.0)
+    # is the original immediate-eviction behavior.
+    evict_max_fails: int = 1
+    evict_backoff_ms: float = 0.0
 
     def validate(self) -> None:
         if self.discovery not in ("kad-dht", "extended"):
@@ -54,6 +60,10 @@ class KadConfig:
             raise ValueError("n_probe must be >= 0")
         if self.n_bootstrap + self.n_probe > self.network_size:
             raise ValueError("roles exceed network size")
+        if self.evict_max_fails < 1:
+            raise ValueError("evict_max_fails must be >= 1")
+        if self.evict_backoff_ms < 0.0:
+            raise ValueError("evict_backoff_ms must be >= 0")
 
 
 @dataclass
@@ -140,17 +150,27 @@ class KadSimulator:
     def _wave(self, origins, targets):
         """One batched FIND_NODE wave; in extended (KademliaDiscovery) mode
         the origins then connect to the peers they found (kad.connect_found
-        dial-backs) and evict entries whose dial failed (kad.evict_failed) —
-        the mode's observable differences: symmetric knowledge and tables
-        that self-clean under churn."""
+        dial-backs) and evict entries whose dial failed (kad.evict_failed,
+        under the configured retry budget + backoff) — the mode's observable
+        differences: symmetric knowledge and tables that self-clean under
+        churn."""
+        import jax.numpy as jnp
+
+        # sync the device clock to the role program's host clock so the
+        # eviction backoff deadlines are measured in real sim time
+        self.state = self.state.replace(
+            t_ms=jnp.asarray(self.t_ms, jnp.float32))
         res, self.state = kad.find_node(
             self.state, origins, targets, self._stage, self._lat
         )
         if self.extended:
             # dial-out to the found peers: failed dials (dead entries) are
-            # evicted from the dialer's table, successful ones teach the
-            # found peer the origin
-            self.state = kad.evict_failed(self.state, origins, res.closest)
+            # counted against the entry's retry budget and evicted once it
+            # is exhausted; successful ones teach the found peer the origin
+            self.state = kad.evict_failed(
+                self.state, origins, res.closest,
+                max_fails=self.cfg.evict_max_fails,
+                backoff_base_ms=self.cfg.evict_backoff_ms)
             self.state = kad.connect_found(self.state, origins, res.closest)
         return res
 

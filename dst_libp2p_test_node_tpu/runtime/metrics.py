@@ -519,6 +519,12 @@ class CampaignMetrics:
             "dst_testnode_attack_coverage_under_partition",
             "fraction of honest peers on the publisher's side of the cut "
             "(the reachable ceiling while partitioned)", lab)
+        # cross-protocol DHT adversary (ops/dht_adversary.py; populated
+        # when the campaign armed a DHT attack — -1 sentinel otherwise)
+        self.rtable_poison = r.gauge(
+            "dst_testnode_attack_rtable_poison_frac",
+            "attacker share of occupied honest routing-table slots after "
+            "the poisoning waves (-1 = DHT adversary not armed)", lab)
         self.degraded = r.gauge(
             "dst_testnode_attack_campaign_degraded",
             "1 if the supervisor retried or quarantined any trial cell",
@@ -562,6 +568,7 @@ class CampaignMetrics:
                 (self.heal_time, "heal_time_ms"),
                 (self.reconvergence, "post_churn_reconvergence_hb"),
                 (self.coverage_partition, "coverage_under_partition"),
+                (self.rtable_poison, "rtable_poison_frac"),
             ):
                 v = t.get(key)
                 if v is not None and math.isfinite(float(v)) and float(v) >= 0:

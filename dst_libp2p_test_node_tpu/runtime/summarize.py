@@ -199,7 +199,7 @@ def report_campaign(campaign: dict) -> str:
     cols = ("frac \t seed \t attackers \t coverage \t p50_ms \t inflation "
             "\t hb_gray \t recover_hb \t att_score \t evic \t px \t redial "
             "\t recover_ms \t heal_ms \t reconv_hb \t cov_part \t cov90_hb "
-            "\t score_x_hb")
+            "\t score_x_hb \t rt_poison")
     out = [hdr, cols]
     for t in campaign["trials"]:
         out.append(" \t ".join([
@@ -224,6 +224,9 @@ def report_campaign(campaign: dict) -> str:
             # recorder off or the curve never crossed inside the windows
             str(t.get("coverage90_hb", -1)),
             str(t.get("score_cross_hb", -1)),
+            # cross-protocol DHT adversary (ops/dht_adversary.py); -1 =
+            # DHT not armed for this trial
+            _cell(t.get("rtable_poison_frac", -1.0), ".4f"),
         ]))
     out.append(
         f"Trials :  {len(campaign['trials'])}  trials/s :  "

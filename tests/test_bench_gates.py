@@ -207,10 +207,12 @@ def test_bench_tripwire_is_keyed_per_config(tmp_path):
     light = bench.best_committed_peer_rounds(config_key="pre-r5-light")
     assert light is not None and light > 25e6  # r01-r04 bucket keeps 31.4M
     # the live bench emits its key explicitly, and explicit beats derived.
-    # The exact-default flip rides the key: the mode suffix opens a FRESH
-    # bucket, so the first exact run compares against nothing instead of
-    # tripping a false regression against the committed bounded rows
-    assert bench.BENCH_CONFIG == "n100000-r300-m3-exact"
+    # Workload-identity changes ride the key: the exact-default flip added
+    # the mode suffix, and the cross-protocol DHT probe the -dht suffix —
+    # each opens a FRESH bucket, so the first run of a new shape compares
+    # against nothing instead of tripping a false regression against
+    # committed rows of the old shape
+    assert bench.BENCH_CONFIG == "n100000-r300-m3-exact-dht"
     assert bench.best_committed_peer_rounds(
         config_key=bench.BENCH_CONFIG) is None
     assert bench._config_key_of(

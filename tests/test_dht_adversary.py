@@ -1,0 +1,356 @@
+"""Cross-protocol DHT adversary (ops/dht_adversary.py) contracts:
+
+  - cohort material is host-side deterministic (same seed => same sybil
+    keys / directory / insert batch; zero device PRNG);
+  - sybil clustering actually lands the cohort inside the victim's prefix;
+  - routing-table poisoning stays inside the closed-form occupancy budget,
+    measured as the EXCESS over the organically-acquired attacker share
+    (attackers are real peers, so honest tables pick up ~fraction attacker
+    entries through benign lookup learning — only the insert wave is the
+    attack's doing);
+  - the lookup eclipse replaces attacker responses with sybil-only
+    shortlists, so eclipsed lookups surface a measurably larger attacker
+    share than honest ones over the same tables;
+  - every disabled path literally delegates: find_node_attacked without the
+    eclipse IS kad.find_node, run_dht_recovery_heartbeats without a pool IS
+    run_recovery_heartbeats — bit-identical, same jit cache entry, no extra
+    PRNG splits;
+  - starvation degrades gracefully: an empty PX pool plus a fully refusing
+    DHT pool grows starve_hb monotonically without wedging, and recovery
+    resumes when the pool heals (the heal-after-eclipse campaign leg).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dst_libp2p_test_node_tpu.ops import dht_adversary as da
+from dst_libp2p_test_node_tpu.ops import kad
+from dst_libp2p_test_node_tpu.ops.adversary import attacker_cohort
+from dst_libp2p_test_node_tpu.ops.graph import build_connection_graph
+from dst_libp2p_test_node_tpu.ops.heartbeat import run_heartbeats
+from dst_libp2p_test_node_tpu.ops.repair import (
+    RepairParams, run_dht_recovery_heartbeats, run_recovery_heartbeats,
+)
+from dst_libp2p_test_node_tpu.ops.state import (
+    SimParams, graph_arrays, init_state,
+)
+
+N = 128
+STAGE = jnp.zeros((N,), jnp.int32)
+LAT = jnp.full((2, 2), 50.0, jnp.float32)
+
+
+def _dht(**over):
+    kw = dict(warmup_waves=2, lookup_rounds=2)
+    kw.update(over)
+    return da.DhtAdversaryParams(**kw)
+
+
+def _cohort(fraction=0.2, seed=1):
+    return attacker_cohort(N, fraction, seed=seed)
+
+
+# ------------------------------------------------------------------ cohorts
+
+
+def test_cohort_material_is_deterministic():
+    att = _cohort()
+    st = kad.init_kad_state(N, seed=3)
+    keys = np.asarray(st.keys)
+    k1 = da.mint_sybil_keys(keys, att, 4, 16, seed=7)
+    k2 = da.mint_sybil_keys(keys, att, 4, 16, seed=7)
+    np.testing.assert_array_equal(k1, k2)
+    assert not np.array_equal(k1, da.mint_sybil_keys(keys, att, 4, 16,
+                                                     seed=8))
+    # honest keys untouched; zero prefix bits is the identity
+    honest = ~att.astype(bool)
+    np.testing.assert_array_equal(k1[honest], keys[honest])
+    np.testing.assert_array_equal(
+        da.mint_sybil_keys(keys, att, 4, 0, seed=7), keys)
+    c1 = da.poison_candidates(N, att, 8, seed=7)
+    np.testing.assert_array_equal(c1, da.poison_candidates(N, att, 8,
+                                                           seed=7))
+    assert att[c1].all()  # every candidate is an attacker id
+    d1 = da.sybil_directory(keys, att, 4, 64)
+    np.testing.assert_array_equal(d1, da.sybil_directory(keys, att, 4, 64))
+    ids = d1[d1 >= 0]
+    assert ids.size == int(att.sum()) and att[ids].all()
+
+
+def test_sybil_cluster_lands_inside_victim_prefix():
+    att = _cohort()
+    victim = 4
+    prefix = 24
+    st = kad.init_kad_state(N, seed=3)
+    keys = np.asarray(st.keys)
+    minted = da.mint_sybil_keys(keys, att, victim, prefix, seed=7)
+    d = np.bitwise_xor(minted[att.astype(bool)], minted[victim])
+    bitlen = np.asarray(kad.xor_bitlen(jnp.asarray(d)))
+    # shared top `prefix` bits => XOR distance fits in KEY_BITS - prefix
+    assert (bitlen <= 32 * kad.KEY_WORDS - prefix).all()
+    # and the cohort therefore ranks closest to the victim by construction
+    order = kad.true_closest(minted, minted[victim], k=int(att.sum()) + 1)
+    near = [p for p in order if p != victim][: int(att.sum())]
+    assert att[near].all()
+
+
+def test_rtable_poison_excess_within_closed_form_budget():
+    att = _cohort()
+    armed = _dht(rtable_poison=True)
+    benign = _dht(discovery=True)
+    ks_a, _ = da.build_attacked_dht(N, seed=1, dht=armed, attacker=att,
+                                    victim=4, stage=STAGE, lat_ms=LAT)
+    ks_b, _ = da.build_attacked_dht(N, seed=1, dht=benign, attacker=att,
+                                    victim=4, stage=STAGE, lat_ms=LAT)
+    frac_a = da.rtable_poison_frac(ks_a, att)
+    frac_b = da.rtable_poison_frac(ks_b, att)
+    budget = da.rtable_poison_budget(armed.poison_per_peer, armed.n_buckets,
+                                     armed.k_bucket)
+    # organic presence alone is substantial (attackers are real peers); the
+    # insert wave's EXCESS is what the budget bounds
+    excess = frac_a - frac_b
+    assert 0.0 < excess <= budget, (frac_a, frac_b, budget)
+    # count form (denominator-free): the wave can add at most per_peer
+    # entries to any honest row
+    attb = att.astype(bool)
+    rt_a = np.asarray(ks_a.rtable)[~attb]
+    rt_b = np.asarray(ks_b.rtable)[~attb]
+    extra = ((attb[np.clip(rt_a, 0, None)] & (rt_a >= 0)).sum(axis=(1, 2))
+             - (attb[np.clip(rt_b, 0, None)] & (rt_b >= 0)).sum(axis=(1, 2)))
+    assert extra.max() <= armed.poison_per_peer
+    # zero-attacker cohort: nothing to measure, nothing inserted
+    none = np.zeros(N, dtype=bool)
+    ks_0, d0 = da.build_attacked_dht(N, seed=1, dht=armed, attacker=none,
+                                     victim=4, stage=STAGE, lat_ms=LAT)
+    assert d0 is None
+    assert da.rtable_poison_frac(ks_0, none) == 0.0
+
+
+def test_budget_closed_form_shapes():
+    # uniform keys: one 8-sybil wave on a 16x8 table caps at 8/128
+    assert da.rtable_poison_budget(8, 16, 8) == pytest.approx(8 / 128)
+    # clustering shifts mass into deeper buckets but never past k_bucket
+    for p in (0, 8, 15, 128):
+        b = da.rtable_poison_budget(8, 16, 8, prefix_bits=p)
+        assert 0.0 < b <= 1.0
+    # the saturating regime: enough sybils to fill every bucket
+    assert da.rtable_poison_budget(10_000, 4, 2) == 1.0
+
+
+def test_lookup_eclipse_poisons_responses():
+    att = _cohort()
+    dht = _dht(lookup_eclipse=True)
+    ks, directory = da.build_attacked_dht(N, seed=1, dht=dht, attacker=att,
+                                          victim=4, stage=STAGE, lat_ms=LAT)
+    assert directory is not None
+    att_dev = jnp.asarray(att)
+    honest = np.nonzero(~att.astype(bool))[0][:16]
+    origins = jnp.asarray(honest, jnp.int32)
+    targets = ks.keys[jnp.asarray([4] * len(honest), jnp.int32)]
+    res_e, _ = da.find_node_attacked(ks, origins, targets, STAGE, LAT, dht,
+                                     attacker=att_dev, directory=directory,
+                                     rounds=3)
+    res_h, _ = kad.find_node(ks, origins, targets, STAGE, LAT, rounds=3)
+
+    def att_share(res):
+        c = np.asarray(res.closest)
+        got = c[c >= 0]
+        return att[got].mean() if got.size else 0.0
+
+    assert att_share(res_e) > att_share(res_h), (
+        "eclipsed lookups should surface more sybils than honest ones")
+
+
+# ------------------------------------------------- disabled-path delegation
+
+
+def test_disabled_find_node_is_bit_identical_and_same_cache_entry():
+    from dst_libp2p_test_node_tpu.runtime.profiling import count_retraces
+
+    att = _cohort()
+    dht = _dht()  # nothing armed
+    ks, _ = da.build_attacked_dht(N, seed=1, dht=_dht(discovery=True),
+                                  attacker=att, victim=4, stage=STAGE,
+                                  lat_ms=LAT)
+    origins = jnp.arange(16, dtype=jnp.int32)
+    targets = ks.keys[origins]
+    # warm the cache with the exact call form the delegation uses: jit's
+    # fastpath keys on the bound-call layout, so an omitted-default call
+    # and an explicit shortlist=32 call occupy different entries
+    res_p, st_p = kad.find_node(ks, origins, targets, STAGE, LAT, rounds=3,
+                                shortlist=32)
+    jax.block_until_ready(st_p.rtable)
+    with count_retraces() as counter:
+        res_d, st_d = da.find_node_attacked(ks, origins, targets, STAGE,
+                                            LAT, dht, rounds=3)
+        jax.block_until_ready(st_d.rtable)
+    assert counter.count == 0, counter.events
+    for a, b in zip(jax.tree_util.tree_leaves((res_p, st_p)),
+                    jax.tree_util.tree_leaves((res_d, st_d))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def _sim_fixture(n=64, seed=0):
+    g = build_connection_graph(n, 8, seed=seed)
+    params = SimParams(n=n, capacity=g.capacity, slow_weight=-10.0,
+                       slow_decay=0.9, gossip_threshold=-10.0,
+                       publish_threshold=-20.0, graylist_threshold=-50.0)
+    params = RepairParams(evict=True, redial=True, px=False).apply(params)
+    state = init_state(params, seed=seed)
+    a = graph_arrays(g)
+    state = run_heartbeats(state, a["conns"], a["rev"], a["out_mask"],
+                           params, 6)
+    return params, state, a
+
+
+def test_disabled_recovery_window_is_literal_delegation():
+    from dst_libp2p_test_node_tpu.runtime.profiling import count_retraces
+
+    params, state, a = _sim_fixture()
+    att = jnp.asarray(attacker_cohort(params.n, 0.2, seed=1))
+    # warm with the exact call form the delegation uses (explicit default
+    # kwargs) — jit's fastpath keys on the bound-call layout
+    plain = run_recovery_heartbeats(
+        state, a["conns"], a["rev"], a["out_mask"], att, params, 4,
+        publisher=3, batch_factor=1, telemetry=None)
+    jax.block_until_ready(plain[0][0].key)
+    with count_retraces() as counter:
+        gated = run_dht_recovery_heartbeats(
+            state, a["conns"], a["rev"], a["out_mask"], att, params, 4,
+            dht_pool=None, publisher=3)
+        jax.block_until_ready(gated[0][0].key)
+    assert counter.count == 0, counter.events
+    for lp, lg in zip(jax.tree_util.tree_leaves(plain),
+                      jax.tree_util.tree_leaves(gated)):
+        np.testing.assert_array_equal(np.asarray(lp), np.asarray(lg))
+
+
+def test_armed_window_keeps_the_plain_key_schedule():
+    # the dht_pool/refuse hooks must not add PRNG splits: after the same
+    # number of rounds the armed and plain windows hold the SAME PRNG key
+    params, state, a = _sim_fixture()
+    att = jnp.asarray(attacker_cohort(params.n, 0.2, seed=1))
+    pool = jnp.full((params.n, kad.K_RESP), -1, jnp.int32)
+    (st_p, *_), _ = run_recovery_heartbeats(
+        state, a["conns"], a["rev"], a["out_mask"], att, params, 4,
+        publisher=3)
+    (st_a, *_), _ = run_dht_recovery_heartbeats(
+        state, a["conns"], a["rev"], a["out_mask"], att, params, 4,
+        dht_pool=pool, publisher=3)
+    np.testing.assert_array_equal(np.asarray(st_p.key), np.asarray(st_a.key))
+
+
+# ------------------------------------------------- starvation + heal resume
+
+
+def test_starvation_grows_monotonically_then_heals():
+    # empty PX pool + a DHT pool of nothing but refusing sybils: the
+    # controller must starve gracefully (monotone starve_hb, no wedge).
+    # Swapping in a healed pool mid-window resumes recovery.
+    params, state, a = _sim_fixture()
+    att_np = attacker_cohort(params.n, 0.25, seed=2)
+    att = jnp.asarray(att_np)
+    att_ids = np.nonzero(att_np)[0]
+    # sever every honest->attacker mesh edge trigger: hostile penalty makes
+    # the evictor prune attacker edges, starving honest peers below d_low
+    state = state.replace(slow_penalty=jnp.where(
+        att[jnp.clip(a["conns"], 0)] & (a["conns"] >= 0),
+        jnp.float32(100.0), state.slow_penalty))
+    poisoned = jnp.asarray(np.resize(att_ids, (params.n, kad.K_RESP))
+                           .astype(np.int32))
+    (st1, cn1, rv1, om1, pool1), obs1 = run_dht_recovery_heartbeats(
+        state, a["conns"], a["rev"], a["out_mask"], att, params, 6,
+        dht_pool=poisoned, publisher=3)
+    starve = np.asarray(obs1["starve_max"])
+    assert starve[-1] > 0.0, "nobody starved — the scenario is inert"
+    assert (np.diff(starve) >= 0).all(), "starvation must grow monotonically"
+    # refused dials must not connect a single sybil edge
+    sub = np.asarray(cn1) != np.asarray(a["conns"])
+    changed = np.asarray(cn1)[sub]
+    assert not att_np[changed[changed >= 0]].any(), (
+        "a refusing sybil completed a handshake")
+    # the DHT heals: an honest shortlist resumes recovery on the SAME state
+    honest_ids = np.nonzero(~att_np.astype(bool))[0]
+    healed = jnp.asarray(np.resize(honest_ids, (params.n, kad.K_RESP))
+                         .astype(np.int32))
+    (st2, *_), obs2 = run_dht_recovery_heartbeats(
+        st1, cn1, rv1, om1, att, params, 6, dht_pool=healed, publisher=3)
+    assert float(np.asarray(obs2["redials"]).sum()) > 0, (
+        "healed pool produced no successful redials")
+    assert float(np.asarray(obs2["starve_max"])[-1]) < starve[-1], (
+        "starvation did not recede after the DHT healed")
+
+
+def test_repair_pool_entries_are_consumed_on_examine():
+    params, state, a = _sim_fixture()
+    att = jnp.asarray(attacker_cohort(params.n, 0.25, seed=2))
+    state = state.replace(slow_penalty=jnp.where(
+        att[jnp.clip(a["conns"], 0)] & (a["conns"] >= 0),
+        jnp.float32(100.0), state.slow_penalty))
+    honest_ids = np.nonzero(~np.asarray(att, bool))[0]
+    pool = jnp.asarray(np.resize(honest_ids, (params.n, kad.K_RESP))
+                       .astype(np.int32))
+    (_, _, _, _, pool2), obs = run_dht_recovery_heartbeats(
+        state, a["conns"], a["rev"], a["out_mask"], att, params, 6,
+        dht_pool=pool, publisher=3)
+    left = np.asarray(obs["dht_pool_left"])
+    assert left[-1] < float((np.asarray(pool) >= 0).sum()), (
+        "no DHT candidate was ever examined")
+    assert (np.diff(left) <= 0).all(), "pool entries must only be consumed"
+    assert ((np.asarray(pool2) >= 0).sum()) == left[-1]
+
+
+# ------------------------------------------------ acceptance (campaign-level)
+
+
+@pytest.mark.slow
+def test_eclipsed_recovery_is_slower_than_px_fed_baseline():
+    # the PR's headline acceptance: at fraction 0.2 with the PX pool
+    # removed, re-dialing from the ECLIPSED discovery shortlist must still
+    # recover (finite recovery_time_ms) but strictly slower on average
+    # than the PX-fed baseline; and the heal-after-eclipse sweep recovers
+    # to >= 0.9x benign coverage
+    import math
+
+    from dst_libp2p_test_node_tpu.config.topology import TopoParams
+    from dst_libp2p_test_node_tpu.runtime.campaign import (
+        CampaignConfig, attack_gossipsub, run_campaign,
+    )
+    from dst_libp2p_test_node_tpu.runtime.simulator import ExperimentConfig
+
+    exp = ExperimentConfig(
+        topo=TopoParams(network_size=128, anchor_stages=2, min_bandwidth=50,
+                        max_bandwidth=150, min_latency=40, max_latency=130,
+                        msg_size_bytes=2000, messages=2, delay_seconds=1.0),
+        connect_to=8, gossipsub=attack_gossipsub(flood_publish=False),
+        warmup_s=8.0, seed=0)
+    common = dict(scenario="eclipse_publisher", fractions=(0.2,),
+                  seeds=(0, 1, 2, 3), experiment=exp,
+                  attack_heartbeats=10, recovery_heartbeats=12)
+    eclipsed = run_campaign(CampaignConfig(
+        **common, repair=RepairParams(evict=True, redial=True, px=False),
+        dht=da.DhtAdversaryParams(lookup_eclipse=True, rtable_poison=True)))
+    px_fed = run_campaign(CampaignConfig(
+        **common, repair=RepairParams(evict=True, redial=True, px=True)))
+    a_ms = [t.recovery_time_ms for t in eclipsed.trials]
+    b_ms = [t.recovery_time_ms for t in px_fed.trials]
+    assert all(math.isfinite(x) and x > 0 for x in a_ms), a_ms
+    assert all(t.rtable_poison_frac > 0 for t in eclipsed.trials)
+    # per-seed: eclipse never HELPS recovery; in aggregate it strictly hurts
+    assert all(xa >= xb for xa, xb in zip(a_ms, b_ms)), (a_ms, b_ms)
+    assert sum(a_ms) > sum(b_ms), (a_ms, b_ms)
+
+    healed = run_campaign(CampaignConfig(
+        **common, repair=RepairParams(evict=True, redial=True, px=False),
+        dht=da.DhtAdversaryParams(lookup_eclipse=True, rtable_poison=True,
+                                  heal_hb=6)))
+    benign = run_campaign(CampaignConfig(
+        scenario="eclipse_publisher", fractions=(0.0,), seeds=(0, 1, 2, 3),
+        experiment=exp, attack_heartbeats=10))
+    ben_cov = sum(t.honest_coverage for t in benign.trials) / 4
+    for t in healed.trials:
+        assert t.honest_coverage >= 0.9 * ben_cov, (
+            t.seed, t.honest_coverage, ben_cov)

@@ -252,3 +252,65 @@ def test_evict_failed_removes_dead_found_entries():
                 hole = True
             else:
                 assert not hole, row
+
+
+def test_evict_failed_retry_budget_and_backoff():
+    # the retry budget: with max_fails=2 one lossy dial wave charges the
+    # entry but keeps it; re-failing while the exponential-backoff deadline
+    # is live is NOT re-counted (the dial was never retried); once the
+    # clock passes the deadline the second genuine failure evicts; a
+    # successful dial resets both counters. Defaults (max_fails=1)
+    # reproduce the original immediate eviction bit-for-bit.
+    state = kad.init_kad_state(32, seed=0)
+    state = kad.rtable_insert(
+        state, jnp.asarray([1]), jnp.asarray([[2, 3, 4]]))
+    alive = np.ones(32, bool)
+    alive[3] = False
+    state = state.replace(alive=jnp.asarray(alive))
+    origins = jnp.asarray([1])
+    found = jnp.asarray([[3, 2]])
+
+    def slot_of(s, entry):
+        pos = np.nonzero(np.asarray(s.rtable[1]) == entry)
+        assert len(pos[0]) == 1
+        return pos[0][0], pos[1][0]
+
+    # wave 1: first failure charges the counter, arms the backoff, keeps
+    # the entry
+    s1 = kad.evict_failed(state, origins, found, max_fails=2,
+                          backoff_base_ms=100.0)
+    b, k = slot_of(s1, 3)
+    assert int(s1.rt_fails[1, b, k]) == 1
+    np.testing.assert_allclose(float(s1.rt_retry_ms[1, b, k]), 100.0)
+
+    # wave 2 inside the backoff window (t_ms unchanged): no re-count, no
+    # eviction — the entry was never re-dialed
+    s2 = kad.evict_failed(s1, origins, found, max_fails=2,
+                          backoff_base_ms=100.0)
+    b, k = slot_of(s2, 3)
+    assert int(s2.rt_fails[1, b, k]) == 1
+
+    # wave 3 past the deadline: the second genuine failure reaches the
+    # budget and evicts (bucket stays left-packed)
+    s3 = kad.evict_failed(
+        s2.replace(t_ms=s2.t_ms + 1000.0), origins, found, max_fails=2,
+        backoff_base_ms=100.0)
+    after = np.asarray(s3.rtable[1])
+    assert not (after == 3).any()
+    assert (after == 2).any() and (after == 4).any()
+
+    # a successful dial resets the charged counter and the deadline
+    revived = s1.replace(alive=jnp.ones(32, bool))
+    s4 = kad.evict_failed(revived, origins, found, max_fails=2,
+                          backoff_base_ms=100.0)
+    b, k = slot_of(s4, 3)
+    assert int(s4.rt_fails[1, b, k]) == 0
+    assert float(s4.rt_retry_ms[1, b, k]) == 0.0
+
+    # defaults reproduce the original immediate-eviction tables exactly
+    s_now = kad.evict_failed(state, origins, found)
+    s_budget1 = kad.evict_failed(state, origins, found, max_fails=1,
+                                 backoff_base_ms=0.0)
+    np.testing.assert_array_equal(np.asarray(s_now.rtable),
+                                  np.asarray(s_budget1.rtable))
+    assert not (np.asarray(s_now.rtable[1]) == 3).any()

@@ -334,6 +334,53 @@ def test_faulted_sharded_campaign_equals_vmapped():
                 err_msg=f"{k} diverged at seed {tv.seed}")
 
 
+def _dht_cfg(**over):
+    # lookup eclipse + rtable poisoning with a mid-window heal: exercises
+    # both recovery legs (attacked pool, then healed pool resuming the same
+    # per-trial dialed graphs) on top of the repair subsystem
+    from dst_libp2p_test_node_tpu.ops.dht_adversary import DhtAdversaryParams
+
+    kw = dict(
+        fractions=(0.0, 0.2), seeds=(0, 1, 2, 3), experiment=_exp(),
+        attack_heartbeats=4, recovery_heartbeats=4,
+        repair=RepairParams(evict=True, redial=True),
+        dht=DhtAdversaryParams(lookup_eclipse=True, rtable_poison=True,
+                               heal_hb=2, warmup_waves=1, lookup_rounds=2))
+    kw.update(over)
+    return CampaignConfig(**kw)
+
+
+@pytest.mark.parametrize("groups", [2, 4])
+def test_dht_attacked_sharded_campaign_equals_vmapped(groups):
+    # the cross-protocol window on the nested grid: per-seed poisoned DHT
+    # pools shard with the trial batch, both recovery legs (eclipsed pool,
+    # healed pool) run under shard_map — same trial metrics as the
+    # single-device vmapped sweep, poison fraction included
+    r_v = run_campaign(_dht_cfg())
+    r_s = run_campaign(_dht_cfg(), trial_mesh=make_trial_mesh(groups))
+    _assert_trials_close(r_v.trials, r_s.trials)
+    for tv, ts in zip(r_v.trials, r_s.trials):
+        np.testing.assert_allclose(
+            tv.rtable_poison_frac, ts.rtable_poison_frac, rtol=1e-5,
+            err_msg=f"rtable_poison_frac diverged at seed {tv.seed}")
+        if tv.fraction > 0.0:
+            # the DHT was built and measured for every attacked trial
+            assert tv.rtable_poison_frac >= 0.0
+
+
+def test_dht_zero_attacker_trials_exact_under_sharding():
+    # fraction-0.0 cells take the benign path even with the DHT adversary
+    # armed: metrics EXACTLY equal sharded-vs-not and the poison channel
+    # stays at its -1 sentinel (no cohort -> no sybils -> nothing to build)
+    r_v = run_campaign(_dht_cfg(fractions=(0.0,)))
+    r_s = run_campaign(_dht_cfg(fractions=(0.0,)),
+                       trial_mesh=make_trial_mesh(2))
+    for tv, ts in zip(r_v.trials, r_s.trials):
+        assert tv.honest_coverage == ts.honest_coverage
+        assert tv.latency_p50_ms == ts.latency_p50_ms
+        assert tv.rtable_poison_frac == ts.rtable_poison_frac == -1.0
+
+
 def test_inert_repair_leaves_stripped_from_attack_window():
     params, state, a = _make_op_fixture(
         slow_weight=-10.0, slow_decay=0.9, graylist_threshold=-50.0,
