@@ -74,7 +74,10 @@ DELIVERY_MODE = "exact"
 # tripwire: a run that also builds the poisoned DHT and times the
 # DHT-backed recovery window opens its own comparison bucket instead of
 # comparing against pre-DHT artifacts of the same workload shape
-BENCH_CONFIG = f"n{N_PEERS}-r{HB_ROUNDS}-m{MESSAGES}-{DELIVERY_MODE}-dht"
+# the "-svc" suffix does the same for the resident-service probe: a run
+# that also drives the admission/dispatch overload rung opens its own
+# bucket instead of comparing against pre-service artifacts
+BENCH_CONFIG = f"n{N_PEERS}-r{HB_ROUNDS}-m{MESSAGES}-{DELIVERY_MODE}-dht-svc"
 
 
 def attribution_split(
@@ -588,6 +591,33 @@ def main() -> None:
         "examine contract broke and the probe timed a no-op pool")
     assert np.isfinite(dht_attack_trials_per_s) and dht_attack_trials_per_s > 0.0
 
+    # resident-service probe (ARCHITECTURE §16): drive the in-process
+    # admission/dispatch path at 2x the dispatcher's per-round capacity on
+    # a small dedicated multitopic sim. requests_per_s is the service-mode
+    # rung; p99_ms the admitted-latency bound under overload; shed_rate
+    # proves the offered load actually exceeded capacity (a probe that
+    # never sheds timed an idle queue, not an overloaded one)
+    from dst_libp2p_test_node_tpu.runtime.traffic import run_service_load
+
+    svc_rep = run_service_load(
+        n_peers=48, subnets=2, connect_to=6, warmup_s=5.0, seed=0,
+        ticks=10, per_tick=4, tick_ms=150.0,
+        max_queue_depth=4, max_batch=2, via_http=False)
+    svc_rps = svc_rep["requests_per_s"]
+    svc_p99 = svc_rep["p99_ms"]
+    assert svc_rep["queue_bound_held"], (
+        f"service queue depth {svc_rep['max_depth_seen']} exceeded the "
+        "admission cap: backpressure is not bounding the resident queue")
+    assert svc_rps is not None and np.isfinite(svc_rps) and svc_rps > 0.0, (
+        f"service_requests_per_s {svc_rps!r}: the overload probe "
+        "dispatched nothing — the service rung measured an idle loop")
+    assert svc_p99 is not None and np.isfinite(svc_p99), (
+        f"service p99 {svc_p99!r} not finite under overload: admitted "
+        "requests are not completing within the run")
+    assert 0.0 < svc_rep["shed_rate"] < 1.0, (
+        f"service shed_rate {svc_rep['shed_rate']:.3f} outside (0,1): the "
+        "2x-capacity probe either never overloaded or admitted nothing")
+
     rounds = MESSAGES * per_burst
     value = N_PEERS * rounds / wall
     # coverage and percentiles over ALL timed messages, not the last one's
@@ -738,6 +768,22 @@ def main() -> None:
                 "rtable_poison_budget": round(poison_budget, 4),
                 "honest_lookup_success": round(lookup_hits, 4),
                 "pool_left_final": float(pool_left[-1]),
+            },
+            # resident-service probe: in-process submit()/pump() at 2x
+            # dispatcher capacity (runtime/traffic.py ETH2-style mix); the
+            # gates above pin shed_rate in (0,1) and a finite p99 before
+            # any artifact is emitted
+            "service_requests_per_s": round(svc_rps, 3),
+            "service_p99_ms": round(svc_p99, 3),
+            "service": {
+                "overload_factor": svc_rep["config"]["overload_factor"],
+                "offered": svc_rep["offered"],
+                "admitted": svc_rep["admitted"],
+                "rejected": svc_rep["rejected"],
+                "dispatched": svc_rep["dispatched"],
+                "shed_rate": round(svc_rep["shed_rate"], 4),
+                "p50_ms": round(svc_rep["p50_ms"], 3),
+                "max_depth_seen": svc_rep["max_depth_seen"],
             },
             "p50_ms": float(np.percentile(delays[ok], 50)),
             "p99_ms": float(np.percentile(delays[ok], 99)),

@@ -568,6 +568,28 @@ def cmd_serve(argv: list[str]) -> int:
                    help="heartbeats run before serving (mesh stabilization, "
                    "main.nim:466-477)")
     p.add_argument("--store-metrics-dir", default=None)
+    # resident-runtime surface (ARCHITECTURE §16): admission control,
+    # batching dispatch, supervision, crash-safe warm restart
+    p.add_argument("--queue-depth", type=int, default=1024,
+                   help="bounded admission queue; overflow answers 429")
+    p.add_argument("--device-ms-budget", type=float, default=0.0,
+                   help="reject once est. queued device ms exceeds this")
+    p.add_argument("--deadline-ms", type=float, default=0.0,
+                   help="default per-request sim-time deadline (0 = none)")
+    p.add_argument("--max-batch", type=int, default=64,
+                   help="dispatches per service round (tenant round-robin)")
+    p.add_argument("--dispatch-timeout-s", type=float, default=0.0)
+    p.add_argument("--max-retries", type=int, default=1)
+    p.add_argument("--retry-backoff-s", type=float, default=0.05)
+    p.add_argument("--inject-failures", type=int, default=0,
+                   help="force the first K dispatch attempts to fail (CI)")
+    p.add_argument("--checkpoint", default=None,
+                   help="service checkpoint path (periodic + final flush)")
+    p.add_argument("--checkpoint-every", type=int, default=0,
+                   help="flush every K service rounds (0 = final only)")
+    p.add_argument("--drain-deadline-s", type=float, default=5.0)
+    p.add_argument("--resume", action="store_true",
+                   help="warm-restart from --checkpoint if it exists")
     a = p.parse_args(argv)
 
     from .config.env import (
@@ -576,11 +598,50 @@ def cmd_serve(argv: list[str]) -> int:
         env_float,
         get_peer_details,
     )
-    from .runtime.node_service import serve_forever
+    from .runtime.node_service import ServiceConfig, serve_forever
     from .runtime.simulator import ExperimentConfig, Simulator
 
     node = get_peer_details()
     node.validate()  # reject unknown muxer / connect_to >= peers at startup
+    svc_cfg = ServiceConfig(
+        max_queue_depth=a.queue_depth,
+        device_ms_budget=a.device_ms_budget,
+        default_deadline_ms=a.deadline_ms,
+        max_batch=a.max_batch,
+        dispatch_timeout_s=a.dispatch_timeout_s,
+        max_retries=a.max_retries,
+        retry_backoff_s=a.retry_backoff_s,
+        inject_failures=a.inject_failures,
+        checkpoint_path=a.checkpoint,
+        checkpoint_every=a.checkpoint_every,
+        drain_deadline_s=a.drain_deadline_s,
+    )
+    svc_cfg.validate()
+    if a.resume and not a.checkpoint:
+        p.error("--resume requires --checkpoint")
+    resume_from = a.checkpoint if (a.resume and a.checkpoint
+                                   and os.path.exists(a.checkpoint)) else None
+    if resume_from is not None:
+        # warm restart: the checkpoint carries sim + service state, so skip
+        # building and warming a simulator that restore() would discard
+        store_dir = a.store_metrics_dir
+        if store_dir is None and node.in_shadow:
+            store_dir = "."
+        control = (a.control_port if a.control_port is not None
+                   else HTTP_CONTROL_PORT)
+        metrics = (a.metrics_port if a.metrics_port is not None
+                   else PROMETHEUS_PORT)
+        print(f"node service warm-restarting from {resume_from}, "
+              f"control :{control} metrics :{metrics}")
+        serve_forever(
+            None, node,
+            control_port=control, metrics_port=metrics,
+            time_scale=a.time_scale, tick_s=a.tick_s,
+            duration_s=a.duration_s,
+            store_metrics_dir=store_dir, out=sys.stdout,
+            service=svc_cfg, resume_from=resume_from,
+        )
+        return 0
     topo = TopoParams(
         network_size=node.network_size,
         muxer=node.muxer,
@@ -636,6 +697,7 @@ def cmd_serve(argv: list[str]) -> int:
         control_port=control, metrics_port=metrics,
         time_scale=a.time_scale, tick_s=a.tick_s, duration_s=a.duration_s,
         store_metrics_dir=store_dir, out=sys.stdout,
+        service=svc_cfg,
     )
     return 0
 

@@ -36,7 +36,14 @@ from ..config.env import GossipSubParams
 from ..config.topology import Topology, TopoParams
 from .simulator import ExperimentConfig, MessageRecord, Simulator
 
-FORMAT_VERSION = 9  # bump on any SimState layout change (v9: optional
+FORMAT_VERSION = 10  # bump on any SimState layout change (v10: resident
+#                     service mode — snapshots may carry a `service_json`
+#                     sidecar (pending publish queue + counters, read only
+#                     by NodeService.restore) and a meta "kind" that extends
+#                     the format to MultiTopicSimulator (host/subscribed_np
+#                     + per-record records/topic_idx); single-topic v9
+#                     snapshots load unchanged and plain load_checkpoint
+#                     ignores the sidecar; v9: optional
 #                     kad/* leaves — a campaign snapshot taken with the DHT
 #                     adversary armed embeds the per-trial KadState so the
 #                     poisoned routing tables are auditable offline; the
@@ -109,41 +116,64 @@ def _records_from_arrays(z) -> list[MessageRecord]:
     ]
 
 
-def save_checkpoint(sim: Simulator, path: str, kad_state=None) -> None:
-    """Snapshot a Simulator to `path` (.npz).
+def save_checkpoint(sim, path: str, kad_state=None,
+                    service_meta: dict | None = None) -> None:
+    """Snapshot a Simulator or MultiTopicSimulator to `path` (.npz).
 
     `kad_state`: optional ops.kad.KadState. Campaign trials running with
     the DHT adversary armed pass their per-trial Kademlia state so the
     poisoned routing tables travel with the snapshot (offline audit,
     `rtable_poison_frac` recomputation). Resume does NOT read these
-    leaves — the campaign re-derives the DHT from (seed, dht config)."""
+    leaves — the campaign re-derives the DHT from (seed, dht config).
+
+    `service_meta`: optional strict-JSON dict from the resident NodeService
+    (pending publish queue, counters, fairness cursor). Stored as a sidecar
+    read only by NodeService.restore; load_checkpoint ignores it."""
     from flax import serialization
 
+    multitopic = hasattr(sim, "topic_index")
     meta = {
         "version": FORMAT_VERSION,
+        "kind": "multitopic" if multitopic else "single",
         "graph_sha256": _graph_hash(sim.graph),
         "cfg": asdict(sim.cfg),
         "hb_carry_ms": sim._hb_carry_ms,
         "msg_rng_state": sim._msg_rng.bit_generator.state,
-        "last_msg_id": sim._last_msg_id,
         "t_ms": float(sim.state.t_ms),
     }
-    arrays: dict = {"meta_json": np.frombuffer(
-        json.dumps(meta, allow_nan=False).encode(), dtype=np.uint8)}
+    arrays: dict = {}
+    if multitopic:
+        # the stacked sim has no publisher-rotation cursor or SUBSCRIBE
+        # event counters; its host extras are the subscription draw and the
+        # per-record topic routing
+        arrays["host/subscribed_np"] = sim.subscribed_np
+        topic_of = {t: i for i, t in enumerate(sim.cfg.topics)}
+        arrays.update(_records_arrays([rec for _, rec in sim.records]))
+        if sim.records:
+            arrays["records/topic_idx"] = np.asarray(
+                [topic_of[t] for t, _ in sim.records], dtype=np.int64)
+    else:
+        meta["last_msg_id"] = sim._last_msg_id
+        # host-side counters that are NOT SimState leaves: cumulative
+        # SUBSCRIBE/UNSUBSCRIBE control-message events (a projection from
+        # current state diverges under churn — simulator.py set_subscribed)
+        arrays["host/sub_events"] = sim._sub_events_np
+        arrays["host/unsub_events"] = sim._unsub_events_np
+        arrays.update(_records_arrays(sim.records))
+    arrays["meta_json"] = np.frombuffer(
+        json.dumps(meta, allow_nan=False).encode(), dtype=np.uint8)
     for k, v in serialization.to_state_dict(sim.state).items():
         arrays[f"state/{k}"] = np.asarray(v)
-    # host-side counters that are NOT SimState leaves: cumulative
-    # SUBSCRIBE/UNSUBSCRIBE control-message events (a projection from
-    # current state diverges under churn — simulator.py set_subscribed)
-    arrays["host/sub_events"] = sim._sub_events_np
-    arrays["host/unsub_events"] = sim._unsub_events_np
     topo = sim.topology
     for k in _TOPO_KEYS:
         arrays[f"topo/{k}"] = np.asarray(getattr(topo, k))
-    arrays.update(_records_arrays(sim.records))
     if kad_state is not None:
         for k, v in serialization.to_state_dict(kad_state).items():
             arrays[f"kad/{k}"] = np.asarray(v)
+    if service_meta is not None:
+        arrays["service_json"] = np.frombuffer(
+            json.dumps(service_meta, allow_nan=False).encode(),
+            dtype=np.uint8)
     # atomic replace: a crash mid-write (the exact event checkpoints exist
     # to survive) must not truncate the previous good snapshot
     tmp = f"{path}.tmp"
@@ -152,8 +182,18 @@ def save_checkpoint(sim: Simulator, path: str, kad_state=None) -> None:
     os.replace(tmp, path)
 
 
+def load_service_meta(path: str) -> dict:
+    """Read the resident-service sidecar out of a checkpoint; {} when the
+    snapshot was written without one (plain sim checkpoints)."""
+    z = np.load(path)
+    if "service_json" not in z:
+        return {}
+    return json.loads(bytes(z["service_json"]).decode())
+
+
 def load_checkpoint(path: str, mesh=None) -> Simulator:
-    """Rebuild a Simulator that continues exactly where `path` left off.
+    """Rebuild a Simulator (or MultiTopicSimulator, for snapshots stamped
+    kind="multitopic") that continues exactly where `path` left off.
 
     `mesh`: re-shard the restored state over this device mesh (a sharded
     run does NOT remember its mesh — device topology is a property of the
@@ -162,14 +202,17 @@ def load_checkpoint(path: str, mesh=None) -> Simulator:
 
     z = np.load(path)
     meta = json.loads(bytes(z["meta_json"]).decode())
-    if meta["version"] not in (5, 6, 7, 8, FORMAT_VERSION):
-        # v5..v8 differ only by absent leaves with safe fresh-run defaults:
+    if meta["version"] not in (5, 6, 7, 8, 9, FORMAT_VERSION):
+        # v5..v9 differ only by absent leaves with safe fresh-run defaults:
         # per-record answer_wait (record reader), the warm-start carry
         # (INF below), the mesh-repair leaves (empty pool / zero
-        # counters below), and v9's write-only kad/* extras — accept all
+        # counters below), v9's write-only kad/* extras, and v10's
+        # service sidecar / multitopic kind — accept all
         raise ValueError(
             f"checkpoint format {meta['version']} != supported {FORMAT_VERSION}"
         )
+    if meta.get("kind", "single") == "multitopic":
+        return _load_multitopic(z, meta, mesh)
     cfg_d = dict(meta["cfg"])
     topo_p = TopoParams(**cfg_d.pop("topo"))
     gs = GossipSubParams(**cfg_d.pop("gossipsub"))
@@ -228,4 +271,48 @@ def load_checkpoint(path: str, mesh=None) -> Simulator:
     sim._msg_rng.bit_generator.state = meta["msg_rng_state"]
     sim._last_msg_id = int(meta.get("last_msg_id", -1))
     sim.records = _records_from_arrays(z)
+    return sim
+
+
+def _load_multitopic(z, meta: dict, mesh):
+    """kind="multitopic" restore path: same contract as the single-topic
+    branch — rebuild from config, verify the physical graph hash, replace
+    the stacked state leaves, restore the host extras."""
+    from flax import serialization
+
+    from .multitopic import MultiTopicConfig, MultiTopicSimulator
+
+    cfg_d = dict(meta["cfg"])
+    topo_p = TopoParams(**cfg_d.pop("topo"))
+    gs = GossipSubParams(**cfg_d.pop("gossipsub"))
+    cfg_d["topics"] = tuple(cfg_d["topics"])
+    cfg = MultiTopicConfig(topo=topo_p, gossipsub=gs, **cfg_d)
+    topology = Topology(topo_p, *(z[f"topo/{k}"] for k in _TOPO_KEYS))
+    sim = MultiTopicSimulator(cfg, topology=topology, mesh=mesh)
+    got = _graph_hash(sim.graph)
+    want = meta.get("graph_sha256", "")
+    if want and got != want:
+        raise ValueError(
+            "checkpoint graph mismatch: the rebuilt connection graph "
+            f"(sha256 {got[:12]}…) differs from the one the checkpoint was "
+            f"written against ({want[:12]}…)."
+        )
+    state_dict = {
+        k.split("/", 1)[1]: z[k] for k in z.files if k.startswith("state/")
+    }
+    sim.state = serialization.from_state_dict(sim.state, state_dict)
+    sim.subscribed_np = np.asarray(z["host/subscribed_np"]).copy()
+    if mesh is not None:
+        from ..parallel.sharding import shard_simulation
+
+        sim.state, _, _ = shard_simulation(sim.state, {}, {}, mesh)
+    sim._hb_carry_ms = float(meta["hb_carry_ms"])
+    sim._msg_rng.bit_generator.state = meta["msg_rng_state"]
+    recs = _records_from_arrays(z)
+    if recs:
+        idx = z["records/topic_idx"]
+        sim.records = [(cfg.topics[int(idx[i])], r)
+                       for i, r in enumerate(recs)]
+    else:
+        sim.records = []
     return sim

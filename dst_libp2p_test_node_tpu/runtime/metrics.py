@@ -287,6 +287,52 @@ class NodeMetrics:
         self.rpc_drop = r.counter(
             "libp2p_pubsub_rpc_drop_total", "Number of dropped RPCs")
 
+        # --- dst_service_* family (resident service runtime, ARCH §16) ------
+        # admission / backpressure / supervision / restart counters of the
+        # long-running NodeService; per-tenant series are the tenant-facing
+        # stream of the multi-tenant dispatcher
+        self.service_queue_depth = r.gauge(
+            "dst_service_queue_depth",
+            "current depth of the bounded admission queue")
+        self.service_admitted = r.counter(
+            "dst_service_admitted_total",
+            "requests admitted past admission control", ("tenant",))
+        self.service_dropped = r.counter(
+            "dst_service_dropped_requests_total",
+            "requests dropped by reason: backpressure (429), "
+            "deadline (shed expired), draining (503)", ("reason",))
+        self.service_batches = r.counter(
+            "dst_service_batches_total",
+            "non-empty dispatch batches pumped")
+        self.service_latency = r.histogram(
+            "dst_service_request_latency_ms",
+            "admission-to-dispatch sojourn of served requests (host wall)",
+            ("tenant",))
+        self.service_failures = r.counter(
+            "dst_service_dispatch_failures_total",
+            "supervised dispatch attempts that raised")
+        self.service_retries = r.counter(
+            "dst_service_dispatch_retries_total",
+            "dispatch retries after a failed attempt")
+        self.service_quarantined = r.counter(
+            "dst_service_quarantined_total",
+            "poison requests dropped after exhausting the retry budget")
+        self.service_degraded = r.gauge(
+            "dst_service_degraded",
+            "1 once any dispatch needed a retry or was quarantined")
+        self.service_draining = r.gauge(
+            "dst_service_draining",
+            "1 while the service refuses new admissions for shutdown")
+        self.service_checkpoints = r.counter(
+            "dst_service_checkpoint_flushes_total",
+            "service checkpoints flushed (periodic + final)")
+        self.service_restarts = r.gauge(
+            "dst_service_restarts_total",
+            "warm restarts this service lineage has survived")
+        self.service_est_dispatch = r.gauge(
+            "dst_service_est_dispatch_ms",
+            "EWMA of one dispatch's wall ms (admission budget estimator)")
+
     # ------------------------------------------------------------ observers
 
     def on_publish_request(self, ok: bool = True) -> None:

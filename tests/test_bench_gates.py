@@ -208,11 +208,12 @@ def test_bench_tripwire_is_keyed_per_config(tmp_path):
     assert light is not None and light > 25e6  # r01-r04 bucket keeps 31.4M
     # the live bench emits its key explicitly, and explicit beats derived.
     # Workload-identity changes ride the key: the exact-default flip added
-    # the mode suffix, and the cross-protocol DHT probe the -dht suffix —
-    # each opens a FRESH bucket, so the first run of a new shape compares
-    # against nothing instead of tripping a false regression against
-    # committed rows of the old shape
-    assert bench.BENCH_CONFIG == "n100000-r300-m3-exact-dht"
+    # the mode suffix, the cross-protocol DHT probe the -dht suffix, and
+    # the resident-service probe the -svc suffix — each opens a FRESH
+    # bucket, so the first run of a new shape compares against nothing
+    # instead of tripping a false regression against committed rows of
+    # the old shape
+    assert bench.BENCH_CONFIG == "n100000-r300-m3-exact-dht-svc"
     assert bench.best_committed_peer_rounds(
         config_key=bench.BENCH_CONFIG) is None
     assert bench._config_key_of(
@@ -286,3 +287,22 @@ def test_bench_guards_repair_probe():
     emit = src.index("json.dumps(out")
     assert src.index("assert evictions_total > 0") < emit
     assert src.index("assert att_share_repair <= att_share_attack") < emit
+
+
+def test_bench_guards_service_probe():
+    # the resident-service probe (ISSUE 13) must refuse to emit an
+    # artifact where the overload run didn't overload: shed_rate pinned
+    # inside (0,1) proves the offered load exceeded dispatch capacity AND
+    # some requests were still admitted, and a non-finite p99 means
+    # admitted work never completed. Same ordering contract as the other
+    # probe gates: asserts precede emit.
+    src = open(os.path.join(REPO, "bench.py")).read()
+    assert '0.0 < svc_rep["shed_rate"] < 1.0' in src
+    assert "np.isfinite(svc_p99)" in src
+    assert 'svc_rep["queue_bound_held"]' in src
+    assert '"service_requests_per_s"' in src
+    assert '"service_p99_ms"' in src
+    emit = src.index("json.dumps(out")
+    assert src.index('0.0 < svc_rep["shed_rate"] < 1.0') < emit
+    assert src.index("np.isfinite(svc_p99)") < emit
+    assert src.index('svc_rep["queue_bound_held"]') < emit
