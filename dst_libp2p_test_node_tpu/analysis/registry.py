@@ -19,6 +19,10 @@ The registered surface mirrors the BENCH hot paths exactly:
                           answer_queue_mode="serial" (1 surviving cond: the
                           repair branch only — no nested fallback to trace)
   disseminate/bounded     bounded-accounting publish (cond-free by design)
+  publisher/batch_scan    the batched service dispatch (ISSUE 14): a scan
+                          over stacked seed columns, disseminate/cold's 2
+                          conds surviving in the body plus the padding
+                          active-mask cond (3 total)
   heartbeat_step          one mesh-maintenance round (4 steady-state skips)
   run_heartbeats          the simulator scan step (conds must survive the
                           scan body)
@@ -104,6 +108,23 @@ def _disseminate_spec(**params_over) -> TraceSpec:
         args=(state, a["conns"], a["rev"], stage, lat, bw),
         kwargs=dict(publisher=3, t0_ms=0.0, params=params,
                     payload_bytes=15000))
+
+
+def _publish_batch_spec() -> TraceSpec:
+    import numpy as np
+
+    from ..runtime.publisher import publish_batch_scan
+
+    g, params, state, a, (stage, lat, bw) = _single_topic()
+    rows = np.full(4, 3, dtype=np.int32)
+    active = np.ones(4, dtype=bool)
+    return TraceSpec(
+        fn=publish_batch_scan,
+        args=(state, a["conns"], a["rev"], stage, lat, bw, rows, active),
+        kwargs=dict(t0_ms=0.0, params=params, payload_bytes=15000,
+                    fragments=1, with_gossip=True, loss_stage=None,
+                    loss_mode="tcp", lat_edge=None, loss_edge=None,
+                    ans_tables=None, valid_edge=None, with_fanout=False))
 
 
 def _heartbeat_spec(fn_name: str, **params_over) -> TraceSpec:
@@ -519,6 +540,18 @@ def default_contracts() -> list[EntrypointContract]:
             expected_conds=None,
             feedback=[(_new_state_of, _state_arg_of)],
             notes="cond-free by design; loop/carry rules still apply"),
+        EntrypointContract(
+            name="publisher/batch_scan",
+            build=_publish_batch_spec,
+            expected_conds=3,
+            feedback=[(_new_state_of, _state_arg_of)],
+            notes="the batched service dispatch (ISSUE 14): one scan over "
+                  "stacked seed columns whose body is disseminate/cold — "
+                  "its 2 conds must survive inside the scan body, plus the "
+                  "per-column active-mask cond that makes padding to a "
+                  "static batch width free (a select_n there would publish "
+                  "the padding columns); the carried SimState must feed "
+                  "back aval-stable so every pump round is a cache hit"),
         EntrypointContract(
             name="heartbeat_step",
             build=lambda: _heartbeat_spec("heartbeat_step"),

@@ -577,7 +577,12 @@ def cmd_serve(argv: list[str]) -> int:
     p.add_argument("--deadline-ms", type=float, default=0.0,
                    help="default per-request sim-time deadline (0 = none)")
     p.add_argument("--max-batch", type=int, default=64,
-                   help="dispatches per service round (tenant round-robin)")
+                   help="requests per service round (tenant round-robin)")
+    p.add_argument("--dispatch-mode", default="batched",
+                   choices=("batched", "sequential"),
+                   help="batched = one stacked device dispatch per "
+                   "same-shape group of the round (ISSUE 14); sequential = "
+                   "the pinned per-request reference path")
     p.add_argument("--dispatch-timeout-s", type=float, default=0.0)
     p.add_argument("--max-retries", type=int, default=1)
     p.add_argument("--retry-backoff-s", type=float, default=0.05)
@@ -608,6 +613,7 @@ def cmd_serve(argv: list[str]) -> int:
         device_ms_budget=a.device_ms_budget,
         default_deadline_ms=a.deadline_ms,
         max_batch=a.max_batch,
+        dispatch_mode=a.dispatch_mode,
         dispatch_timeout_s=a.dispatch_timeout_s,
         max_retries=a.max_retries,
         retry_backoff_s=a.retry_backoff_s,
@@ -858,6 +864,9 @@ def cmd_inject(argv: list[str]) -> int:
     p.add_argument("--topic", default="test")
     p.add_argument("--peer-selection", choices=["id", "rotation"], default="id")
     p.add_argument("--publisher-id", type=int, default=0)
+    p.add_argument("--burst", type=int, default=1,
+                   help="messages posted back-to-back before each delay — "
+                   "gives a batched-dispatch service multi-request rounds")
     a = p.parse_args(argv)
 
     from .runtime.publisher import inject
@@ -865,6 +874,7 @@ def cmd_inject(argv: list[str]) -> int:
     res = inject(
         a.targets, a.msg_size, a.messages, a.delay_s, topic=a.topic,
         peer_selection=a.peer_selection, publisher_id=a.publisher_id,
+        burst=a.burst,
     )
     for r in res.replies:
         print(json.dumps(r, allow_nan=False))

@@ -332,6 +332,19 @@ class NodeMetrics:
         self.service_est_dispatch = r.gauge(
             "dst_service_est_dispatch_ms",
             "EWMA of one dispatch's wall ms (admission budget estimator)")
+        # batched device dispatch (ISSUE 14): one compiled scan serves a
+        # whole same-shape group of the pump round's fair batch
+        self.service_dispatches = r.counter(
+            "dst_service_device_dispatches_total",
+            "compiled device dispatches executed (a batched dispatch "
+            "serves many requests; sequential mode serves one each)")
+        self.service_splits = r.counter(
+            "dst_service_batch_splits_total",
+            "failed batch dispatches bisected to isolate a poison request "
+            "(the PR-6 per-seed split fallback at batch granularity)")
+        self.service_batch_factor = r.gauge(
+            "dst_service_batch_factor",
+            "requests served per device dispatch, last non-empty pump round")
 
     # ------------------------------------------------------------ observers
 

@@ -320,6 +320,88 @@ class MultiTopicSimulator:
         self.records.append((topic, rec))
         return rec
 
+    def publish_batch(self, items, msg_size: int | None = None,
+                      pad_to: int | None = None) -> list[MessageRecord]:
+        """Batched device dispatch across topics (ISSUE 14): `items` is a
+        sequence of (topic, publisher) pairs injected at the current sim
+        time as ONE compiled scan over stacked seed columns.
+
+        The topic is a ROW INDEX (ti * n + publisher), not a static, so one
+        batch freely mixes topics — the eth2 att-subnet lane batches across
+        its subnets. Only msg_size and the fanout flag are static bucket
+        keys (mixed fanout raises; callers group). The scan body replays
+        the cross-topic uplink/rx occupancy fold between columns, making
+        the batch bit-identical to the sequential publish loop
+        (tests/test_batched_dispatch.py pins the mixed-topic case).
+        `pad_to` fixes the compiled scan width as in Simulator.publish_batch.
+        """
+        pairs = [(str(t), int(p)) for t, p in items]
+        if not pairs:
+            return []
+        if self.mesh is not None:
+            return [self.publish(t, p, msg_size=msg_size) for t, p in pairs]
+        n = self.n_peers
+        t_ct = len(self.cfg.topics)
+        tis = [self.topic_index(t) for t, _ in pairs]
+        subbed = {bool(self.subscribed_np[ti][p])
+                  for ti, (_, p) in zip(tis, pairs)}
+        if len(subbed) != 1:
+            raise ValueError(
+                "publish_batch requires a uniform fanout bucket: mixed "
+                "subscribed/unsubscribed (topic, publisher) pairs in one "
+                "batch — group them first (NodeService._group_batch does)")
+        with_fanout = not subbed.pop()
+        size = msg_size if msg_size is not None else self.cfg.topo.msg_size_bytes
+        a = self.arrays
+        t0_ms = float(self.state.t_ms) + self._hb_carry_ms
+        b = len(pairs)
+        width = b if pad_to is None else max(int(pad_to), b)
+        rows = np.zeros(width, dtype=np.int32)
+        rows[:b] = [ti * n + p for ti, (_, p) in zip(tis, pairs)]
+        active = np.zeros(width, dtype=bool)
+        active[:b] = True
+
+        from .publisher import publish_batch_scan
+
+        ys, self.state = publish_batch_scan(
+            self.state, a["conns"], a["rev"], self._stage, self._lat,
+            self._bw, rows, active, t0_ms, self.params, size,
+            self.cfg.topo.num_frags, self.cfg.with_gossip, self._loss,
+            self.cfg.loss_mode, self._lat_edge, self._loss_edge,
+            self._ans_tables, None, with_fanout, topic_blocks=t_ct)
+
+        ys_np = {k: np.asarray(v) for k, v in ys.items()}
+
+        class _BlkCol:  # one request's topic-block window of the batch ys
+            __slots__ = ("delay_ms", "received", "sends", "copies_rx",
+                         "ihave_sent", "iwant_sent", "answer_wait_max_ms")
+
+            def __init__(self, i, blk):
+                self.delay_ms = ys_np["delay_ms"][i][blk]
+                self.received = ys_np["received"][i][blk]
+                self.sends = ys_np["sends"][i][blk]
+                self.copies_rx = ys_np["copies_rx"][i][blk]
+                self.ihave_sent = ys_np["ihave_sent"][i][blk]
+                self.iwant_sent = ys_np["iwant_sent"][i][blk]
+                # scalar, covers the whole stacked publish (see _Blk above)
+                self.answer_wait_max_ms = ys_np["answer_wait_max_ms"][i]
+
+        recs = []
+        for i, (ti, (topic, pub)) in enumerate(zip(tis, pairs)):
+            rec = record_from_result(
+                _BlkCol(i, slice(ti * n, (ti + 1) * n)),
+                msg_id=int(self._msg_rng.integers(0, 2**63, dtype=np.int64)),
+                publisher=pub,
+                t0_ms=t0_ms,
+                drop_self=pub
+                if (not self.cfg.self_trigger
+                    or not self.subscribed_np[ti][pub])
+                else None,
+            )
+            self.records.append((topic, rec))
+            recs.append(rec)
+        return recs
+
     # --------------------------------------------------------------- metrics
 
     def mesh_sizes(self) -> dict:

@@ -240,7 +240,13 @@ class ServiceConfig:
     max_queue_depth: int = 1024
     device_ms_budget: float = 0.0     # est. queued device ms cap; 0 = off
     default_deadline_ms: float = 0.0  # relative sim ms per request; 0 = none
-    max_batch: int = 64               # dispatches per pump round
+    max_batch: int = 64               # requests per pump round
+    # "batched": stack each same-shape group of the fair batch into seed
+    # columns and run it as ONE compiled device dispatch (ISSUE 14);
+    # "sequential": one dispatch per request — the pinned bit-equality
+    # reference, same pattern as answer_queue_mode="serial". Both modes
+    # produce bit-identical record streams (tests/test_service_runtime.py).
+    dispatch_mode: str = "batched"
     dispatch_timeout_s: float = 0.0   # watchdog per attempt; 0 = off
     max_retries: int = 1
     retry_backoff_s: float = 0.05     # doubles per retry (campaign pattern)
@@ -255,6 +261,10 @@ class ServiceConfig:
             raise ValueError("max_queue_depth must be >= 1")
         if self.max_batch < 1:
             raise ValueError("max_batch must be >= 1")
+        if self.dispatch_mode not in ("batched", "sequential"):
+            raise ValueError(
+                f"dispatch_mode must be 'batched' or 'sequential', "
+                f"got {self.dispatch_mode!r}")
         for k in ("device_ms_budget", "default_deadline_ms",
                   "dispatch_timeout_s", "retry_backoff_s",
                   "drain_deadline_s", "retry_after_s"):
@@ -332,13 +342,20 @@ class NodeService:
             "admitted": 0, "rejected": 0, "shed_deadline": 0,
             "dispatched": 0, "dispatch_failures": 0, "retries": 0,
             "quarantined": 0, "checkpoint_flushes": 0, "restarts": 0,
+            "batch_splits": 0, "device_dispatches": 0,
         }
         self.degraded = False
         self.draining = False
         self.last_error: str | None = None
         self.pump_rounds = 0
         self.max_depth_seen = 0
-        self._ewma_ms = 0.0  # EWMA of one dispatch's device+host wall (ms)
+        self._ewma_ms = 0.0  # EWMA of one request's DEVICE wall (ms)
+        # per-pump-round accumulators feeding the EWMA: device-call wall
+        # only (no retry-backoff sleeps — those over-shed healthy tenants),
+        # amortized over the requests the round processed
+        self._round_device_ms = 0.0
+        self._round_reqs = 0
+        self._round_dispatches = 0
         self._injector = _FailureInjector(self.svc_cfg.inject_failures)
         # (tenant, sojourn_ms) of recent dispatches — the load driver's
         # latency source; bounded so a long-lived service cannot grow it
@@ -366,6 +383,8 @@ class NodeService:
                     _text_response(self, 200, "ok")
                 elif self.path == "/service":
                     _json_response(self, 200, svc.service_status())
+                elif self.path == "/telemetry":
+                    _json_response(self, 200, svc.telemetry_status())
                 else:
                     _text_response(self, 404, "Not Found")
 
@@ -497,13 +516,67 @@ class NodeService:
             "max_queue_depth": self.svc_cfg.max_queue_depth,
             "max_depth_seen": self.max_depth_seen,
             "est_dispatch_ms": round(self._ewma_ms, 3),
+            "dispatch_mode": self.svc_cfg.dispatch_mode,
             "pump_rounds": self.pump_rounds,
             "counters": dict(self.counters),
             "last_error": self.last_error,
             "topics": list(self._topics),
         }
 
+    def telemetry_status(self) -> dict:
+        """Strict-JSON flight-recorder window (GET /telemetry): the latest
+        armed advance()'s per-heartbeat tel_* curves — the same series the
+        scrape exports as dst_sim_round_* gauges, but as whole curves per
+        channel so a tenant can stream the live per-heartbeat trajectory
+        instead of polling one point per scrape. Empty curves until
+        record_telemetry arms the recorder and an advance runs."""
+        import numpy as np
+
+        from .summarize import sanitize_nonfinite
+
+        tel = getattr(self.sim, "last_telemetry", None) or {}
+        curves = {
+            k: sanitize_nonfinite(np.asarray(v, dtype=np.float64).tolist())
+            for k, v in tel.items() if k.startswith("tel_")
+        }
+        return {
+            "armed": getattr(self.sim, "_telemetry", None) is not None,
+            "sim_t_ms": self._sim_now(),
+            "pump_rounds": self.pump_rounds,
+            "heartbeats": (len(next(iter(curves.values())))
+                           if curves else 0),
+            "curves": curves,
+        }
+
     # --------------------------------------------------------------- dispatch
+
+    def _note_device_ms(self, wall_ms: float, n_requests: int) -> None:
+        """Account one device call's wall toward this round's admission
+        estimate. Only device work is counted — retry-backoff sleeps are
+        deliberately excluded, so a retry storm no longer inflates the
+        queued-device-ms budget and over-sheds healthy tenants."""
+        self._round_device_ms += wall_ms
+        self._round_reqs += n_requests
+        self._round_dispatches += 1
+        self.counters["device_dispatches"] += 1
+        self.metrics.service_dispatches.inc()
+
+    def _commit_publish(self, req: PublishRequest, rec, view: int) -> None:
+        """Success bookkeeping for one served request (shared by the
+        sequential and batched paths): per-tenant sojourn, delivery
+        metrics, and the stdout latency-line contract."""
+        self.metrics.on_publish_request(ok=True)
+        self.counters["dispatched"] += 1
+        sojourn_ms = (time.monotonic() - req.t_enq_wall) * 1000.0
+        self.latencies.append((req.tenant, sojourn_ms))
+        self.metrics.service_latency.observe(
+            sojourn_ms, labels={"tenant": req.tenant})
+        # the stdout contract (main.nim:150): one line per receiver
+        for peer, d in zip(rec.receivers, rec.delays_ms_int):
+            self.lines_out.append(f"{rec.msg_id} milliseconds: {d}")
+            if peer == view:
+                self.metrics.on_delivery(
+                    float(d), chunks=self.sim.cfg.topo.num_frags)
 
     def _dispatch(self, req: PublishRequest, view: int) -> int:
         """One supervised device dispatch: watchdog timeout + bounded
@@ -528,6 +601,7 @@ class NodeService:
                 self.degraded = True
             try:
                 self._injector.maybe_fail()
+                t0 = time.monotonic()
                 rec = _call_with_timeout(run, sup.dispatch_timeout_s)
             except (ValueError, MixDegradedError):
                 # bad request parameters or a degraded mix network. (A view
@@ -540,18 +614,8 @@ class NodeService:
                 self.counters["dispatch_failures"] += 1
                 self.metrics.service_failures.inc()
                 continue
-            self.metrics.on_publish_request(ok=True)
-            self.counters["dispatched"] += 1
-            sojourn_ms = (time.monotonic() - req.t_enq_wall) * 1000.0
-            self.latencies.append((req.tenant, sojourn_ms))
-            self.metrics.service_latency.observe(
-                sojourn_ms, labels={"tenant": req.tenant})
-            # the stdout contract (main.nim:150): one line per receiver
-            for peer, d in zip(rec.receivers, rec.delays_ms_int):
-                self.lines_out.append(f"{rec.msg_id} milliseconds: {d}")
-                if peer == view:
-                    self.metrics.on_delivery(
-                        float(d), chunks=self.sim.cfg.topo.num_frags)
+            self._note_device_ms((time.monotonic() - t0) * 1000.0, 1)
+            self._commit_publish(req, rec, view)
             return 1
         # retry budget exhausted: quarantine the poison request; the service
         # stays up and reports itself degraded instead of crashing
@@ -562,11 +626,98 @@ class NodeService:
         self.metrics.on_publish_request(ok=False)
         return 0
 
+    def _group_key(self, req: PublishRequest, view: int):
+        """Static-shape bucket of one request: msg_size + the fanout flag
+        (an unsubscribed view publishes through the gossipsub v1.1 fanout
+        path, a different compiled program). The topic is NOT part of the
+        key — a multi-topic batch stacks topics as row indices, so the eth2
+        att-subnet lane batches across its subnets."""
+        if self._multitopic:
+            ti = self.sim.topic_index(req.topic)
+            fanout = not bool(self.sim.subscribed_np[ti][view])
+        else:
+            fanout = not bool(self.sim._subscribed_np[view])
+        return (req.msg_size, fanout)
+
+    def _group_batch(self, batch, view: int):
+        """MODE-INVARIANT grouping of the fair batch: groups keyed by
+        static shape bucket in first-appearance order, FIFO within a
+        group. Both dispatch modes iterate these same groups in the same
+        order — dispatch_mode only changes how one group executes (a
+        request loop vs one stacked scan) — which is what makes
+        batched == sequential bit-identity hold for ALL traffic, not just
+        single-bucket rounds."""
+        groups: list[list[PublishRequest]] = []
+        index: dict = {}
+        for req in batch:
+            k = self._group_key(req, view)
+            i = index.get(k)
+            if i is None:
+                index[k] = len(groups)
+                groups.append([req])
+            else:
+                groups[i].append(req)
+        return groups
+
+    def _dispatch_batch(self, reqs: list, view: int) -> int:
+        """One same-bucket group as ONE supervised device dispatch
+        (ISSUE 14). Failure handling lifts the PR-6 per-seed split to
+        batch granularity: a failed batch is bisected and each half
+        re-dispatched, so only the poison request is ever quarantined —
+        never the batch. Single-request groups take the per-request
+        retry/quarantine path directly (keeps sequential-mode counter
+        semantics for the B=1 degenerate case)."""
+        if len(reqs) == 1:
+            return self._dispatch(reqs[0], view)
+        sim = self.sim
+        if (getattr(sim, "mix_params", None) is not None
+                or sim.mesh is not None
+                or not hasattr(sim, "publish_batch")):
+            # mix routing and peer-sharded grids keep the per-publish path
+            # (Simulator.publish_batch documents why); so do foreign sims
+            return sum(self._dispatch(r, view) for r in reqs)
+        sup = self.svc_cfg
+
+        def run():
+            if self._multitopic:
+                return sim.publish_batch(
+                    [(r.topic, view) for r in reqs],
+                    msg_size=reqs[0].msg_size, pad_to=sup.max_batch)
+            return sim.publish_batch(
+                [view] * len(reqs), msg_size=reqs[0].msg_size,
+                pad_to=sup.max_batch)
+
+        try:
+            self._injector.maybe_fail()
+            t0 = time.monotonic()
+            recs = _call_with_timeout(run, sup.dispatch_timeout_s)
+        except (ValueError, MixDegradedError):
+            # request-level rejection at batch granularity can't name the
+            # culprit: re-dispatch each request alone (terminal per
+            # request — _dispatch never retries these)
+            return sum(self._dispatch(r, view) for r in reqs)
+        except Exception as e:  # noqa: BLE001 — the supervisor IS the handler
+            self.counters["dispatch_failures"] += 1
+            self.metrics.service_failures.inc()
+            self.counters["batch_splits"] += 1
+            self.metrics.service_splits.inc()
+            self.degraded = True
+            self.last_error = repr(e)
+            mid = len(reqs) // 2
+            return (self._dispatch_batch(reqs[:mid], view)
+                    + self._dispatch_batch(reqs[mid:], view))
+        self._note_device_ms((time.monotonic() - t0) * 1000.0, len(reqs))
+        for r, rec in zip(reqs, recs):
+            self._commit_publish(r, rec, view)
+        return len(reqs)
+
     def pump(self, advance_ms: float = 0.0) -> int:
         """One service round: advance sim time, pop a fair bounded batch
-        (shedding expired requests), dispatch it under the supervisor, emit
-        latency lines, refresh the metrics snapshot, and flush the periodic
-        checkpoint. Returns #published."""
+        (shedding expired requests), group it by static-shape bucket, and
+        dispatch each group under the supervisor — one stacked device
+        dispatch per group in batched mode, one per request in sequential
+        mode — then emit latency lines, refresh the metrics snapshot, and
+        flush the periodic checkpoint. Returns #published."""
         if advance_ms > 0:
             self.sim.advance(advance_ms)
         depth_before = self.publishes.depth()
@@ -580,15 +731,26 @@ class NodeService:
         n_real = (self.sim.n_peers if self._multitopic else self.sim.params.n)
         view = self.cfg.my_id % n_real  # the simulated peer this node's
         # metrics report for (my_id can exceed n via PEER_ID_OFFSET)
-        t_batch0 = time.monotonic()
-        for req in batch:
-            n_pub += self._dispatch(req, view)
+        self._round_device_ms = 0.0
+        self._round_reqs = 0
+        self._round_dispatches = 0
+        for group in self._group_batch(batch, view):
+            if self.svc_cfg.dispatch_mode == "batched":
+                n_pub += self._dispatch_batch(group, view)
+            else:
+                for req in group:
+                    n_pub += self._dispatch(req, view)
         if batch:
             self.metrics.service_batches.inc()
-            # EWMA of one dispatch's wall: the admission budget estimator
-            per_ms = (time.monotonic() - t_batch0) * 1000.0 / len(batch)
-            self._ewma_ms = (per_ms if self._ewma_ms == 0.0
-                             else 0.8 * self._ewma_ms + 0.2 * per_ms)
+            if self._round_reqs:
+                # admission budget estimator: device wall per REQUEST —
+                # amortized over the round's requests, sleeps excluded
+                per_ms = self._round_device_ms / self._round_reqs
+                self._ewma_ms = (per_ms if self._ewma_ms == 0.0
+                                 else 0.8 * self._ewma_ms + 0.2 * per_ms)
+            if self._round_dispatches:
+                self.metrics.service_batch_factor.set(
+                    self._round_reqs / self._round_dispatches)
         self.metrics.fill_from_sim(self.sim, view)
         # flight-recorder window (Simulator.record_telemetry): export the
         # latest per-heartbeat curves as the dst_sim_round_* family
@@ -682,6 +844,8 @@ class NodeService:
             (m.service_retries, "retries", None),
             (m.service_quarantined, "quarantined", None),
             (m.service_checkpoints, "checkpoint_flushes", None),
+            (m.service_splits, "batch_splits", None),
+            (m.service_dispatches, "device_dispatches", None),
         ):
             v = svc.counters.get(key, 0)
             if v:

@@ -173,6 +173,24 @@ def record_from_result(
     )
 
 
+class _BatchColumn:
+    """Per-column view over a stacked publish_batch result: duck-typed like
+    DisseminationResult so record_from_result unstacks one request's record
+    from the batch ys without copying the whole stack."""
+
+    __slots__ = ("delay_ms", "received", "sends", "copies_rx",
+                 "ihave_sent", "iwant_sent", "answer_wait_max_ms")
+
+    def __init__(self, ys_np: dict, i: int):
+        self.delay_ms = ys_np["delay_ms"][i]
+        self.received = ys_np["received"][i]
+        self.sends = ys_np["sends"][i]
+        self.copies_rx = ys_np["copies_rx"][i]
+        self.ihave_sent = ys_np["ihave_sent"][i]
+        self.iwant_sent = ys_np["iwant_sent"][i]
+        self.answer_wait_max_ms = ys_np["answer_wait_max_ms"][i]
+
+
 @dataclass
 class MessageRecord:
     msg_id: int
@@ -611,6 +629,89 @@ class Simulator:
         )
         self.records.append(rec)
         return rec
+
+    def publish_batch(
+        self,
+        publishers,
+        msg_size: int | None = None,
+        pad_to: int | None = None,
+    ) -> list[MessageRecord]:
+        """Inject len(publishers) messages at the current sim time as ONE
+        compiled device dispatch (ISSUE 14, ARCHITECTURE §16).
+
+        The batch runs as a lax.scan over stacked seed columns whose carry
+        is the SimState, so it is bit-identical to calling publish() once
+        per entry in order — same PRNG splits, same uplink/rx occupancy
+        serialization between same-t0 publishes, same warm-start carry
+        (tests/test_batched_dispatch.py pins this) — while paying one
+        dispatch instead of B. All entries share one static shape bucket:
+        one msg_size and one fanout flag (mixed subscribed/unsubscribed
+        publishers raise; callers group first — NodeService does).
+
+        `pad_to` fixes the scan width: columns beyond len(publishers) run a
+        state-passthrough cond branch, so every batch up to that width
+        reuses one compiled program (the service passes its max_batch;
+        None compiles per distinct width). Mix routing and peer-sharded
+        grids keep the per-publish path: mix draws host-coupled routes per
+        message, and the mesh dispatches disseminate under shard_map.
+        """
+        pubs = [int(p) for p in publishers]
+        if not pubs:
+            return []
+        cfg = self.cfg
+        if self.mix_params is not None or self.mesh is not None:
+            return [self.publish(p, msg_size=msg_size) for p in pubs]
+        subbed = {bool(self._subscribed_np[p]) for p in pubs}
+        if len(subbed) != 1:
+            raise ValueError(
+                "publish_batch requires a uniform fanout bucket: mixed "
+                "subscribed/unsubscribed publishers in one batch — group "
+                "them first (NodeService._group_batch does)")
+        with_fanout = not subbed.pop()
+        size = msg_size if msg_size is not None else cfg.topo.msg_size_bytes
+        a = self.arrays
+        t0_ms = float(self.state.t_ms) + self._hb_carry_ms
+        b = len(pubs)
+        width = b if pad_to is None else max(int(pad_to), b)
+        rows = np.zeros(width, dtype=np.int32)
+        rows[:b] = pubs
+        active = np.zeros(width, dtype=bool)
+        active[:b] = True
+
+        from ..ops.state import repair_inert, restore_repair, strip_repair
+        from .publisher import publish_batch_scan
+
+        saved = None
+        if repair_inert(self.params):
+            self.state, saved = strip_repair(self.state)
+        ys, self.state = publish_batch_scan(
+            self.state, a["conns"], a["rev"], self._stage, self._lat,
+            self._bw, rows, active, t0_ms, self.params, size,
+            cfg.topo.num_frags, cfg.with_gossip, self._loss, cfg.loss_mode,
+            self._lat_edge, self._loss_edge, self._ans_tables,
+            self._valid_edge, with_fanout)
+        if saved is not None:
+            self.state = restore_repair(self.state, saved)
+
+        ys_np = {k: np.asarray(v) for k, v in ys.items()}
+        recs = []
+        for i, pub in enumerate(pubs):
+            if cfg.msgid_mode == "go":
+                msg_id = max(int(t0_ms * 1e6), self._last_msg_id + 1)
+                self._last_msg_id = msg_id
+            else:
+                msg_id = int(self._msg_rng.integers(0, 2**63, dtype=np.int64))
+            recs.append(record_from_result(
+                _BatchColumn(ys_np, i),
+                msg_id=msg_id,
+                publisher=pub,
+                t0_ms=t0_ms,
+                drop_self=(
+                    [pub] if (not cfg.self_trigger)
+                    or not self._subscribed_np[pub] else None),
+            ))
+        self.records.extend(recs)
+        return recs
 
     def run(
         self,

@@ -150,6 +150,22 @@ def _records_key(records) -> list[tuple]:
     return out
 
 
+def _records_sha(records) -> str:
+    """Hex digest of the record-stream fingerprint: lets two separate
+    run_service_load invocations (e.g. one per dispatch_mode) assert
+    bit-identity through a strict-JSON artifact without shipping the raw
+    arrays."""
+    import hashlib
+
+    h = hashlib.sha256()
+    for topic, msg_id, t0_ms, delays, received in _records_key(records):
+        h.update(topic.encode())
+        h.update(repr((msg_id, t0_ms)).encode())
+        h.update(delays)
+        h.update(received)
+    return h.hexdigest()
+
+
 def _scrape_counters(svc: NodeService) -> dict:
     """The service-family counters exactly as the /metrics scrape reports
     them (read from the same registry the exposition renders)."""
@@ -163,6 +179,8 @@ def _scrape_counters(svc: NodeService) -> dict:
         "degraded": m.service_degraded.get(),
         "restarts_total": m.service_restarts.get(),
         "checkpoint_flushes_total": m.service_checkpoints.get(),
+        "batch_splits_total": m.service_splits.get(),
+        "device_dispatches_total": m.service_dispatches.get(),
     }
 
 
@@ -184,6 +202,7 @@ def run_service_load(
     max_retries: int = 1,
     retry_backoff_s: float = 0.0,
     inject_failures: int = 0,
+    dispatch_mode: str = "batched",
     kill_at_tick: int | None = None,
     checkpoint_path: str | None = None,
     checkpoint_every: int = 2,
@@ -225,7 +244,7 @@ def run_service_load(
             default_deadline_ms=deadline_ms,
             dispatch_timeout_s=dispatch_timeout_s,
             max_retries=max_retries, retry_backoff_s=retry_backoff_s,
-            inject_failures=inject,
+            inject_failures=inject, dispatch_mode=dispatch_mode,
             checkpoint_path=ckpt, checkpoint_every=checkpoint_every)
 
     kill_block = None
@@ -302,6 +321,7 @@ def run_service_load(
             "ticks": ticks, "per_tick": per_tick, "tick_ms": tick_ms,
             "max_queue_depth": max_queue_depth, "max_batch": max_batch,
             "deadline_ms": deadline_ms, "inject_failures": inject_failures,
+            "dispatch_mode": dispatch_mode,
             "via_http": via_http, "seed": seed,
             "overload_factor": per_tick / max_batch,
         },
@@ -312,6 +332,8 @@ def run_service_load(
         "dispatched": c["dispatched"],
         "quarantined": c["quarantined"],
         "retries": c["retries"],
+        "batch_splits": c["batch_splits"],
+        "device_dispatches": c["device_dispatches"],
         "degraded": svc.degraded,
         "shed_rate": (shed / offered) if offered else 0.0,
         "requests_per_s": (c["dispatched"] / wall_s
@@ -320,6 +342,7 @@ def run_service_load(
         "p99_ms": p99,
         "max_depth_seen": svc.max_depth_seen,
         "queue_bound_held": svc.max_depth_seen <= max_queue_depth,
+        "records_sha": _records_sha(svc.sim.records),
         "scrape": _scrape_counters(svc),
         "kill": kill_block,
     }

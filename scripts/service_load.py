@@ -58,6 +58,11 @@ def main(argv=None) -> int:
     ap.add_argument("--max-retries", type=int, default=1)
     ap.add_argument("--retry-backoff-s", type=float, default=0.05)
     ap.add_argument("--inject-failures", type=int, default=0)
+    ap.add_argument("--dispatch-mode", default="batched",
+                    choices=("batched", "sequential"),
+                    help="batched = one stacked device dispatch per "
+                    "same-shape group; sequential = the pinned per-request "
+                    "reference (bit-identical record streams either way)")
     ap.add_argument("--kill-at-tick", type=int, default=None)
     ap.add_argument("--checkpoint", default=None)
     ap.add_argument("--checkpoint-every", type=int, default=2)
@@ -76,7 +81,8 @@ def main(argv=None) -> int:
         max_queue_depth=a.queue_depth, max_batch=a.max_batch,
         deadline_ms=a.deadline_ms, dispatch_timeout_s=a.dispatch_timeout_s,
         max_retries=a.max_retries, retry_backoff_s=a.retry_backoff_s,
-        inject_failures=a.inject_failures, kill_at_tick=a.kill_at_tick,
+        inject_failures=a.inject_failures, dispatch_mode=a.dispatch_mode,
+        kill_at_tick=a.kill_at_tick,
         checkpoint_path=a.checkpoint, checkpoint_every=a.checkpoint_every,
         via_http=not a.no_http,
     )

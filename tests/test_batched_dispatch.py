@@ -1,0 +1,154 @@
+"""Batched dispatch bit-equality pins (ISSUE 14, ARCHITECTURE §16).
+
+publish_batch stacks a same-shape group of publishes into one lax.scan
+whose carry is the SimState, so its record stream and post-batch state
+must equal the sequential publish() loop BIT-FOR-BIT — same PRNG splits,
+same uplink/rx occupancy serialization between same-t0 publishes, same
+warm-start carry. These tests pin that contract on the single-topic and
+multitopic simulators, including the padded-width cond path (inactive
+columns must not advance any state), the continued key chain after a
+batch, both msg-id modes, and the uniform-fanout grouping precondition.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from dst_libp2p_test_node_tpu.config.topology import TopoParams
+from dst_libp2p_test_node_tpu.runtime.multitopic import (
+    MultiTopicConfig,
+    MultiTopicSimulator,
+)
+from dst_libp2p_test_node_tpu.runtime.simulator import (
+    ExperimentConfig,
+    Simulator,
+)
+
+
+def _assert_records_equal(a, b):
+    assert len(a) == len(b)
+    for ra, rb in zip(a, b):
+        assert ra.msg_id == rb.msg_id
+        assert ra.publisher == rb.publisher
+        assert ra.t0_ms == rb.t0_ms
+        assert np.array_equal(ra.delays_ms, rb.delays_ms)
+        assert np.array_equal(ra.received, rb.received)
+        assert np.array_equal(ra.sends, rb.sends)
+        assert np.array_equal(ra.copies_rx, rb.copies_rx)
+        assert ra.ihave == rb.ihave
+        assert ra.iwant == rb.iwant
+        assert ra.answer_wait_max_ms == rb.answer_wait_max_ms
+
+
+def _assert_state_equal(sa, sb):
+    la = jax.tree_util.tree_leaves(sa)
+    lb = jax.tree_util.tree_leaves(sb)
+    assert len(la) == len(lb)
+    for xa, xb in zip(la, lb):
+        assert np.array_equal(np.asarray(xa), np.asarray(xb)), \
+            "post-batch SimState diverged from the sequential reference"
+
+
+def _sim(seed=3, msgid_mode="nim"):
+    cfg = ExperimentConfig(
+        topo=TopoParams(network_size=24, msg_size_bytes=800, messages=1),
+        connect_to=5, warmup_s=5.0, seed=seed, msgid_mode=msgid_mode,
+    )
+    s = Simulator(cfg)
+    s.warmup()
+    return s
+
+
+class TestSingleTopic:
+    @pytest.mark.parametrize("msgid_mode", ["nim", "go"])
+    def test_padded_batch_matches_sequential_bitwise(self, msgid_mode):
+        pubs = [2, 7, 2, 11]
+        seq = _sim(msgid_mode=msgid_mode)
+        for p in pubs:
+            seq.publish(p)
+        bat = _sim(msgid_mode=msgid_mode)
+        recs = bat.publish_batch(pubs, pad_to=8)  # 4 live + 4 cond columns
+        assert len(recs) == len(pubs)
+        _assert_records_equal(bat.records, seq.records)
+        _assert_state_equal(bat.state, seq.state)
+
+    def test_pad_width_does_not_change_bits(self):
+        a = _sim()
+        b = _sim()
+        a.publish_batch([1, 5, 9], pad_to=None)
+        b.publish_batch([1, 5, 9], pad_to=16)
+        _assert_records_equal(a.records, b.records)
+        _assert_state_equal(a.state, b.state)
+
+    def test_followup_publish_chains_identically(self):
+        # the batch must leave the PRNG/warm carry exactly where the
+        # sequential loop leaves it: a publish AFTER the batch is the pin
+        seq = _sim()
+        for p in [4, 4, 6]:
+            seq.publish(p)
+        seq.publish(0)
+        bat = _sim()
+        bat.publish_batch([4, 4, 6], pad_to=4)
+        bat.publish(0)
+        _assert_records_equal(bat.records, seq.records)
+        _assert_state_equal(bat.state, seq.state)
+
+    def test_empty_batch_is_noop(self):
+        s = _sim()
+        before = jax.tree_util.tree_map(np.asarray, s.state)
+        assert s.publish_batch([]) == []
+        assert s.records == []
+        _assert_state_equal(s.state, before)
+
+    def test_mixed_fanout_bucket_rejected(self):
+        s = _sim()
+        mask = np.ones(s.params.n, dtype=bool)
+        mask[7] = False  # node 7 publishes via the fanout path
+        s.set_subscribed(mask)
+        with pytest.raises(ValueError, match="uniform fanout"):
+            s.publish_batch([2, 7])
+        # uniform buckets on the same membership still batch
+        uns = s.publish_batch([7], pad_to=2)
+        sub = s.publish_batch([2, 3], pad_to=2)
+        assert len(uns) == 1 and len(sub) == 2
+
+
+class TestMultiTopic:
+    def _pair(self):
+        def make():
+            cfg = MultiTopicConfig(
+                topo=TopoParams(network_size=20, msg_size_bytes=600,
+                                messages=1),
+                topics=("blocks", "att_0", "att_1"), connect_to=5,
+                warmup_s=5.0, seed=11,
+            )
+            s = MultiTopicSimulator(cfg)
+            s.warmup()
+            return s
+        return make(), make()
+
+    def test_mixed_topic_batch_matches_sequential(self):
+        # one batch spanning topics: topics are row indices on the stacked
+        # grid, not static shape, so they share one scan dispatch
+        items = [("blocks", 3), ("att_0", 3), ("att_1", 8), ("att_0", 5)]
+        seq, bat = self._pair()
+        for t, p in items:
+            seq.publish(t, p, msg_size=600)
+        recs = bat.publish_batch(items, msg_size=600, pad_to=8)
+        assert len(recs) == len(items)
+        assert [t for t, _ in seq.records] == [t for t, _ in bat.records]
+        _assert_records_equal([r for _, r in bat.records],
+                              [r for _, r in seq.records])
+        _assert_state_equal(bat.state, seq.state)
+
+    def test_followup_publish_chains_identically(self):
+        seq, bat = self._pair()
+        for t, p in [("att_0", 2), ("att_1", 2)]:
+            seq.publish(t, p, msg_size=600)
+        seq.publish("blocks", 0, msg_size=3000)
+        bat.publish_batch([("att_0", 2), ("att_1", 2)],
+                          msg_size=600, pad_to=4)
+        bat.publish("blocks", 0, msg_size=3000)
+        _assert_records_equal([r for _, r in bat.records],
+                              [r for _, r in seq.records])
+        _assert_state_equal(bat.state, seq.state)
