@@ -83,8 +83,11 @@ DELIVERY_MODE = "exact"
 # mode's best — the two modes are bit-identical in RESULTS but not in
 # requests/s, which is the whole point of the batched engine
 SERVICE_DISPATCH_MODE = "batched"
+# the "-adaptive" suffix keys the adaptive-attacker probe (ISSUE 15) the
+# same way: a run that also times the armed controller window opens a fresh
+# tripwire bucket instead of comparing against pre-adaptive artifacts
 BENCH_CONFIG = (f"n{N_PEERS}-r{HB_ROUNDS}-m{MESSAGES}-{DELIVERY_MODE}"
-                f"-dht-svc-{SERVICE_DISPATCH_MODE}")
+                f"-dht-svc-{SERVICE_DISPATCH_MODE}-adaptive")
 
 
 def attribution_split(
@@ -598,6 +601,54 @@ def main() -> None:
         "examine contract broke and the probe timed a no-op pool")
     assert np.isfinite(dht_attack_trials_per_s) and dht_attack_trials_per_s > 0.0
 
+    # adaptive-attacker probe (ops/adversary.py AdaptivePolicy, ISSUE 15):
+    # one ARMED controller window (same ATTACK_HB and cohort as the static
+    # attack probe) from the post-warm-up state, min-of-3 —
+    # adaptive_attack_trials_per_s. The repair params keep px_pool live so
+    # the PX-poison behavior writes real candidate rows instead of tracing
+    # against the stripped state. Pre-emit gates mirror the other probes: a
+    # controller that never regrafts, never plants a sybil id, or never
+    # throttles measured a disarmed policy, not the adaptive arms race.
+    from dst_libp2p_test_node_tpu.ops.adversary import (
+        AdaptivePolicy, run_adaptive_heartbeats,
+    )
+
+    adv_adaptive = dataclasses.replace(
+        adv, adaptive=AdaptivePolicy(enabled=True))
+
+    def _adaptive_trial():
+        return run_adaptive_heartbeats(
+            state0, a["conns"], a["rev"], a["out_mask"], att_j,
+            params_repair, adv_adaptive, ATTACK_HB)
+
+    (s_ad, ctrl_ad), obs_ad = _adaptive_trial()
+    jax.block_until_ready(s_ad.bytes_tx)            # compile
+    adaptive_s = np.inf
+    for _ in range(3):
+        t1 = time.time()
+        (s_ad, ctrl_ad), obs_ad = _adaptive_trial()
+        jax.block_until_ready(s_ad.bytes_tx)
+        adaptive_s = min(adaptive_s, time.time() - t1)
+    adaptive_attack_trials_per_s = 1.0 / adaptive_s
+    regrafts_total = int(np.asarray(ctrl_ad.regrafts).sum())
+    px_injected_total = int(np.asarray(ctrl_ad.px_injected).sum())
+    throttled_total = int(np.asarray(ctrl_ad.throttled_hb).sum())
+    viol_est_max = float(np.asarray(ctrl_ad.viol_est).max())
+    adaptive_score = float(np.asarray(obs_ad["attacker_score_mean"])[-1])
+    assert regrafts_total > 0, (
+        "adaptive regrafts == 0 after the armed window: the backoff-expiry "
+        "regraft behavior never fired; the probe measured a disarmed "
+        "controller")
+    assert px_injected_total > 0, (
+        "adaptive px_injected == 0 after the armed window: the PX-poison "
+        "behavior planted nothing; the probe measured a disarmed controller")
+    assert throttled_total > 0 and viol_est_max > 0.0, (
+        f"adaptive duty cycle inert (throttled {throttled_total}, "
+        f"viol_est max {viol_est_max}): the score-aware throttle never "
+        "engaged on an armed score surface")
+    assert np.isfinite(adaptive_attack_trials_per_s) \
+        and adaptive_attack_trials_per_s > 0.0
+
     # resident-service probe (ARCHITECTURE §16): drive the in-process
     # admission/dispatch path at 2x the dispatcher's per-round capacity on
     # a small dedicated multitopic sim. requests_per_s is the service-mode
@@ -804,6 +855,23 @@ def main() -> None:
                 "rtable_poison_budget": round(poison_budget, 4),
                 "honest_lookup_success": round(lookup_hits, 4),
                 "pool_left_final": float(pool_left[-1]),
+            },
+            # adaptive-attacker probe: one armed controller window (same
+            # shape as the attack probe, repair leaves live), min-of-3; the
+            # counters are the pre-emit gate inputs and attacker_score is
+            # the duty cycle's whole point — it must sit ABOVE the static
+            # probe's post-window score (throttling trades violations for
+            # score headroom)
+            "adaptive_attack_trials_per_s": round(
+                adaptive_attack_trials_per_s, 3),
+            "adaptive": {
+                "attack_heartbeats": ATTACK_HB,
+                "trial_s": round(adaptive_s, 3),
+                "regrafts_total": regrafts_total,
+                "px_injected_total": px_injected_total,
+                "throttled_hb_total": throttled_total,
+                "viol_est_max": round(viol_est_max, 3),
+                "attacker_score": round(adaptive_score, 2),
             },
             # resident-service probe: in-process submit()/pump() at 2x
             # dispatcher capacity (runtime/traffic.py ETH2-style mix); the

@@ -176,6 +176,25 @@ def _attack_spec() -> TraceSpec:
         kwargs=dict(params=params, adv=AdversaryParams(), steps=4))
 
 
+def _adaptive_attack_spec() -> TraceSpec:
+    import jax.numpy as jnp
+
+    from ..ops.adversary import (AdaptivePolicy, AdversaryParams,
+                                 attacker_cohort, run_adaptive_heartbeats)
+    from ..ops.state import init_adaptive_ctrl
+
+    # repair leaves live: the PX-poison behavior writes px_pool rows and the
+    # audit should see that program, not the stripped fallback
+    g, params, state, a, _ = _single_topic(**_REPAIR)
+    att = jnp.asarray(attacker_cohort(params.n, 0.25, seed=1))
+    adv = AdversaryParams(adaptive=AdaptivePolicy(enabled=True))
+    return TraceSpec(
+        fn=run_adaptive_heartbeats,
+        args=(state, a["conns"], a["rev"], a["out_mask"], att),
+        kwargs=dict(params=params, adv=adv, steps=4,
+                    ctrl=init_adaptive_ctrl(params.n)))
+
+
 def _faults_spec() -> TraceSpec:
     import jax.numpy as jnp
 
@@ -573,6 +592,22 @@ def default_contracts() -> list[EntrypointContract]:
             feedback=[(_first_out, _state_arg_of)],
             notes="UNBATCHED campaign window; the vmapped trial batch "
                   "intentionally elides these conds and is not registered"),
+        EntrypointContract(
+            name="adversary/adaptive_window",
+            build=_adaptive_attack_spec,
+            expected_conds=None,
+            # the armed window widens the carry to (state, ctrl): BOTH feed
+            # the next window — the controller estimate crosses the
+            # attack -> recovery edge, so aval drift in either leaf
+            # recompiles every campaign window
+            feedback=[(lambda out: out[0][0], _state_arg_of),
+                      (lambda out: out[0][1],
+                       lambda spec: spec.kwargs["ctrl"])],
+            notes="the adaptive attacker controller in the scan (ISSUE 15): "
+                  "repair leaves live so PX poison writes real px_pool "
+                  "rows; disabled configs are intentionally NOT registered "
+                  "here — they ARE run_attacked_heartbeats (same cache "
+                  "entry), already audited above"),
         EntrypointContract(
             name="heartbeat_step/evict",
             build=lambda: _heartbeat_spec("heartbeat_step", **_REPAIR),

@@ -20,7 +20,12 @@ Subcommands:
   attack     — adversarial Monte-Carlo campaign (runtime/campaign.py): sweep
                attacker fraction x seed for one of the v1.1 attack scenarios
                (ops/adversary.py, arXiv:2007.02754) and report resilience
-               metrics against the score defense.
+               metrics against the score defense. --adaptive arms the
+               per-round attacker controller inside the heartbeat scan.
+  pareto     — defense Pareto sweep (runtime/campaign.run_defense_sweep):
+               grid over mesh-degree/scoring knobs vs the adaptive attacker,
+               report the coverage/bandwidth/recovery-time front and which
+               configurations dominate the defaults.
   kad        — role-based kad-dht workload (bootstrap/normal/probe).
   connmanager — hub-and-spoke watermark/reconnect stress workload.
   servicedisco — advertise/lookup service discovery over the DHT.
@@ -318,6 +323,61 @@ def cmd_run(argv: list[str]) -> int:
     return 0
 
 
+def validate_attack_flags(
+        scenario: str,
+        *,
+        mimic_margin: float | None = None,
+        rotation_period_hb: int | None = None,
+        dht_attack: bool = False,
+        dht_heal_hb: int = -1,
+        adaptive: bool = False,
+        throttle_margin: float | None = None,
+        px_poison_per_hb: int | None = None,
+) -> None:
+    """Reject incompatible `attack` scenario/flag combinations up front,
+    before any topology is built or jit trace starts — a bad combo should
+    cost milliseconds, not a silent no-op campaign. Raises ValueError with
+    the offending flag named; cmd_attack maps it onto argparse's error path.
+    """
+    from .ops.adversary import ADAPTIVE_SCENARIOS
+
+    if mimic_margin is not None and scenario != "slow_peer_mimicry":
+        raise ValueError(
+            f"--mimic-margin tunes the slow_peer_mimicry score setpoint; "
+            f"scenario {scenario!r} never reads it — drop the flag or use "
+            "--scenario slow_peer_mimicry")
+    if rotation_period_hb is not None and scenario != "identity_rotation":
+        raise ValueError(
+            f"--rotation-period-hb sets the identity_rotation scrub cadence; "
+            f"scenario {scenario!r} never reads it — drop the flag or use "
+            "--scenario identity_rotation")
+    if dht_attack and scenario == "cold_boot_join":
+        raise ValueError(
+            "--dht-eclipse/--dht-poison/--dht-cluster poison discovery "
+            "state built during the attack window, but cold_boot_join "
+            "replays the join race on a fresh topology with no pre-attack "
+            "DHT to poison — drop the --dht-* flags or pick a scenario "
+            "with an established mesh")
+    if dht_heal_hb >= 0 and not dht_attack:
+        raise ValueError(
+            "--dht-heal-hb schedules the recovery round a DHT attack heals "
+            "at, but no DHT attack is armed — add one of --dht-eclipse/"
+            "--dht-poison/--dht-cluster")
+    if adaptive and scenario not in ADAPTIVE_SCENARIOS:
+        raise ValueError(
+            f"--adaptive composes with the graft-flood family "
+            f"{ADAPTIVE_SCENARIOS}, not scenario {scenario!r}: the spam "
+            "scenarios have no backoff/mesh loop to adapt to, mimicry is "
+            "already an adaptive policy, and rotation's identity scrubs "
+            "erase the controller's own estimate")
+    if throttle_margin is not None and not adaptive:
+        raise ValueError("--throttle-margin tunes the adaptive duty cycle; "
+                         "it needs --adaptive")
+    if px_poison_per_hb is not None and not adaptive:
+        raise ValueError("--px-poison-per-hb tunes the adaptive PX poison "
+                         "rate; it needs --adaptive")
+
+
 def cmd_attack(argv: list[str]) -> int:
     """Adversarial campaign driver: one scenario, a fraction x seed grid,
     resilience report + optional JSON/Prometheus artifacts."""
@@ -344,6 +404,25 @@ def cmd_attack(argv: list[str]) -> int:
                    help="campaign seed: builds the shared connection graph")
     p.add_argument("--publisher-id", type=int, default=4)
     p.add_argument("--violation-penalty", type=float, default=1.0)
+    p.add_argument("--mimic-margin", type=float, default=None,
+                   help="slow_peer_mimicry only: pin the attacker score at "
+                   "this fraction of the graylist threshold (0 < m < 1)")
+    p.add_argument("--rotation-period-hb", type=int, default=None,
+                   help="identity_rotation only: heartbeats between "
+                   "identity scrubs (>= 2)")
+    # adaptive attacker controller (ops/adversary.AdaptivePolicy): the
+    # per-round arms race compiled into the heartbeat scan
+    p.add_argument("--adaptive", action="store_true",
+                   help="arm the per-round adaptive attacker controller "
+                   "(backoff-expiry regraft + PX sybil poison + recovery "
+                   "slot race + score-aware duty cycle); graft-flood "
+                   "scenarios only")
+    p.add_argument("--throttle-margin", type=float, default=None,
+                   help="adaptive duty-cycle setpoint as a fraction of the "
+                   "graylist threshold (0 < m < 1); requires --adaptive")
+    p.add_argument("--px-poison-per-hb", type=int, default=None,
+                   help="sybil ids the adaptive attacker plants per victim "
+                   "px_pool row per heartbeat; requires --adaptive")
     p.add_argument("--no-vmap", action="store_true",
                    help="run same-fraction trials sequentially instead of "
                    "one vmapped attack window")
@@ -448,7 +527,7 @@ def cmd_attack(argv: list[str]) -> int:
         except ValueError:
             p.error(f"{flag} must be A:B heartbeat indices, got {spec!r}")
 
-    from .ops.adversary import AdversaryParams
+    from .ops.adversary import AdaptivePolicy, AdversaryParams
     from .ops.dht_adversary import DhtAdversaryParams
     from .ops.faults import FaultParams
     from .ops.repair import RepairParams
@@ -457,8 +536,34 @@ def cmd_attack(argv: list[str]) -> int:
     from .runtime.simulator import ExperimentConfig
     from .runtime.summarize import report_campaign
 
+    try:
+        validate_attack_flags(
+            a.scenario,
+            mimic_margin=a.mimic_margin,
+            rotation_period_hb=a.rotation_period_hb,
+            dht_attack=(a.dht_eclipse or a.dht_poison or a.dht_cluster),
+            dht_heal_hb=a.dht_heal_hb,
+            adaptive=a.adaptive,
+            throttle_margin=a.throttle_margin,
+            px_poison_per_hb=a.px_poison_per_hb,
+        )
+    except ValueError as e:
+        p.error(str(e))
+
     fractions = tuple(float(s) for s in a.fractions.split(",") if s.strip())
     seeds = tuple(int(s) for s in a.seeds.split(",") if s.strip())
+    adv_kw: dict = {}
+    if a.mimic_margin is not None:
+        adv_kw["mimic_margin"] = a.mimic_margin
+    if a.rotation_period_hb is not None:
+        adv_kw["rotation_period_hb"] = a.rotation_period_hb
+    if a.adaptive:
+        pol_kw: dict = {"enabled": True}
+        if a.throttle_margin is not None:
+            pol_kw["throttle_margin"] = a.throttle_margin
+        if a.px_poison_per_hb is not None:
+            pol_kw["px_poison_per_hb"] = a.px_poison_per_hb
+        adv_kw["adaptive"] = AdaptivePolicy(**pol_kw)
     # eclipse needs a mesh-bound publish to have anything to eclipse
     gs = attack_gossipsub(
         flood_publish=(a.scenario != "eclipse_publisher"))
@@ -479,7 +584,8 @@ def cmd_attack(argv: list[str]) -> int:
             warm_start=a.warm_start,
         ),
         adversary=AdversaryParams(
-            scenario=a.scenario, violation_penalty=a.violation_penalty),
+            scenario=a.scenario, violation_penalty=a.violation_penalty,
+            **adv_kw),
         attack_heartbeats=a.attack_heartbeats,
         vmap_trials=not a.no_vmap,
         checkpoint_dir=a.checkpoint_dir,
@@ -549,6 +655,119 @@ def cmd_attack(argv: list[str]) -> int:
             f.write(m.render())
     print(f"[tpu backend] wall={wall:.2f}s trials={len(res.trials)} "
           f"trials/s={res.trials_per_s:.3f}")
+    return 0
+
+
+def cmd_pareto(argv: list[str]) -> int:
+    """Defense Pareto sweep: grid the score-defense knobs (mesh degree band,
+    slow-peer penalty weight) against the ADAPTIVE attacker and report the
+    coverage-vs-bandwidth-vs-recovery-time front (runtime/campaign.
+    run_defense_sweep). Every grid point is a full campaign under a fresh
+    GossipSubParams — i.e. a fresh jit cache entry — so keep grids small."""
+    p = argparse.ArgumentParser(prog="pareto")
+    from .ops.adversary import ADAPTIVE_SCENARIOS
+
+    p.add_argument("--scenario", choices=ADAPTIVE_SCENARIOS,
+                   default="eclipse_publisher",
+                   help="adaptive-capable scenario the sweep defends "
+                   "against (eclipse_publisher gives the sharpest "
+                   "recovery_time_ms separation)")
+    p.add_argument("-n", "--peers", type=int, default=64)
+    p.add_argument("--fractions", default="0.2",
+                   help="comma-separated ATTACKED fractions (> 0); the "
+                   "sweep aggregates over all of them")
+    p.add_argument("--seeds", default="0,1")
+    p.add_argument("--messages", type=int, default=2)
+    p.add_argument("--msg-size", type=int, default=2000)
+    p.add_argument("--delay-s", type=float, default=0.5)
+    p.add_argument("--warmup-s", type=float, default=8.0)
+    p.add_argument("--attack-heartbeats", type=int, default=6)
+    p.add_argument("--recovery-heartbeats", type=int, default=8)
+    p.add_argument("--connect-to", type=int, default=8)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--publisher-id", type=int, default=4)
+    p.add_argument("--throttle-margin", type=float, default=None,
+                   help="adaptive duty-cycle setpoint (0 < m < 1)")
+    p.add_argument("--degree-grid", default="4:6:8,4:4:6",
+                   metavar="DL:D:DH[,...]",
+                   help="comma-separated d_low:d:d_high degree bands to "
+                   "sweep (the defaults are inserted if absent)")
+    p.add_argument("--weight-grid", default="-10",
+                   metavar="W[,...]",
+                   help="comma-separated slow_peer_penalty_weight values "
+                   "(<= 0) to sweep")
+    p.add_argument("--trial-groups", type=int, default=None, metavar="N",
+                   help="nested trial x peer sharding for every campaign "
+                   "in the sweep (parallel/sharding.make_trial_mesh)")
+    p.add_argument("--json", default=None,
+                   help="write the sweep artifact as strict JSON here")
+    a = p.parse_args(argv)
+
+    from .ops.adversary import AdaptivePolicy, AdversaryParams
+    from .ops.repair import RepairParams
+    from .runtime.campaign import (
+        CampaignConfig, attack_gossipsub, run_defense_sweep)
+    from .runtime.simulator import ExperimentConfig
+    from .runtime.summarize import report_defense_sweep
+
+    try:
+        degree_grid = tuple(
+            tuple(int(x) for x in band.split(":"))
+            for band in a.degree_grid.split(",") if band.strip())
+        if any(len(b) != 3 for b in degree_grid):
+            raise ValueError
+    except ValueError:
+        p.error(f"--degree-grid must be DL:D:DH[,DL:D:DH...], got "
+                f"{a.degree_grid!r}")
+    weight_grid = tuple(
+        float(s) for s in a.weight_grid.split(",") if s.strip())
+    fractions = tuple(float(s) for s in a.fractions.split(",") if s.strip())
+    if not fractions or any(f <= 0.0 for f in fractions):
+        p.error("--fractions must list attacked fractions > 0 (the sweep "
+                "measures the defense against the armed attacker; benign "
+                "baselines belong to the attack subcommand)")
+    seeds = tuple(int(s) for s in a.seeds.split(",") if s.strip())
+    pol_kw: dict = {"enabled": True}
+    if a.throttle_margin is not None:
+        pol_kw["throttle_margin"] = a.throttle_margin
+    cfg = CampaignConfig(
+        scenario=a.scenario,
+        fractions=fractions,
+        seeds=seeds,
+        experiment=ExperimentConfig(
+            topo=TopoParams(
+                network_size=a.peers, anchor_stages=3,
+                msg_size_bytes=a.msg_size, messages=a.messages,
+                delay_seconds=a.delay_s),
+            connect_to=a.connect_to,
+            gossipsub=attack_gossipsub(
+                flood_publish=(a.scenario != "eclipse_publisher")),
+            publisher_id=a.publisher_id,
+            warmup_s=a.warmup_s,
+            seed=a.seed,
+        ),
+        adversary=AdversaryParams(
+            scenario=a.scenario, adaptive=AdaptivePolicy(**pol_kw)),
+        attack_heartbeats=a.attack_heartbeats,
+        recovery_heartbeats=a.recovery_heartbeats,
+        repair=RepairParams(evict=True, px=True, redial=True),
+    )
+    trial_mesh = None
+    if a.trial_groups is not None:
+        from .parallel.sharding import make_trial_mesh
+
+        try:
+            trial_mesh = make_trial_mesh(a.trial_groups or None)
+        except ValueError as e:
+            p.error(str(e))
+    sweep = run_defense_sweep(cfg, degree_grid=degree_grid,
+                              weight_grid=weight_grid,
+                              trial_mesh=trial_mesh)
+    print(report_defense_sweep(sweep), end="")
+    if a.json:
+        with open(a.json, "w") as f:
+            # strict JSON: run_defense_sweep sanitizes non-finite values
+            json.dump(sweep, f, indent=2, allow_nan=False)
     return 0
 
 
@@ -1079,6 +1298,8 @@ def main(argv: list[str] | None = None) -> int:
         return cmd_serve(rest)
     if cmd == "attack":
         return cmd_attack(rest)
+    if cmd == "pareto":
+        return cmd_pareto(rest)
     if cmd == "inject":
         return cmd_inject(rest)
     if cmd == "kad":

@@ -68,7 +68,8 @@ import numpy as np
 
 from .heartbeat import heartbeat_step
 from .pull import neighbor_pull_bool, reciprocal_pull_bool
-from .state import (SimParams, SimState, repair_inert, restore_repair,
+from .state import (PX_POOL_WIDTH, AdaptiveCtrl, SimParams, SimState,
+                    init_adaptive_ctrl, repair_inert, restore_repair,
                     strip_repair)
 
 SCENARIOS = (
@@ -96,6 +97,85 @@ SCENARIOS = (
 )
 
 
+# Scenarios the adaptive controller composes with: the graft-flood family,
+# where the attacker's round behavior is mesh pressure the controller can
+# modulate. The spam scenarios have no backoff/mesh feedback loop to adapt
+# to, mimicry IS already a (perfect-information) adaptive policy, and
+# rotation's scrub cadence would erase the controller's own estimate.
+ADAPTIVE_SCENARIOS = ("sybil_graft_flood", "eclipse_publisher",
+                      "cold_boot_join")
+
+
+@dataclass(frozen=True)
+class AdaptivePolicy:
+    """Static (hashable -> jit static arg) per-round attacker controller
+    policy — the adaptive arms race from arXiv:2007.02754 §5 compiled into
+    the heartbeat scan. Disabled (the default) the wrappers LITERALLY
+    delegate to the static runners: same jit cache entry, bit-identical,
+    zero extra PRNG. Armed, a per-attacker controller state (AdaptiveCtrl,
+    ops/state.py) rides the scan carry and the attacker reacts to its own
+    observables each round:
+
+      regraft      re-graft every edge the moment its backoff expires (and
+                   the edge left the mesh) — legal grafts that rebuild
+                   attacker mesh share without accruing the behaviour
+                   penalty.
+      px_poison    answer PX demand with sybil ids: plant attacker ids into
+                   the px_pool rows of honest peers adjacent to the cohort
+                   (px_poison_per_hb plants per victim per round, rotating
+                   through the sorted cohort) — mesh repair's candidate
+                   lattice (PX -> DHT -> random) then dials sybils first.
+      slot_race    during recovery windows, the attacker cohort runs the
+                   repair controller too (run_adaptive_recovery_heartbeats
+                   passes actor=everyone) and ACCEPTS inbound dials — it
+                   races honest dialers for every slot eviction frees.
+      duty_cycle   score-aware throttling: each attacker tracks its own
+                   conservative estimate of the worst honest-side penalty
+                   counter any of its edges carries and stops flooding
+                   whenever one more violation would push its score past
+                   throttle_margin * graylist_threshold. The closed-form
+                   heartbeats_to_graylist budget becomes inf — the
+                   graylist never engages, which is the scenario's finding
+                   (the mimicry precedent), not a config error.
+    """
+
+    enabled: bool = False
+    regraft: bool = True
+    px_poison: bool = True
+    slot_race: bool = True
+    duty_cycle: bool = True
+    # duty-cycle setpoint: throttle when the predicted counter would exceed
+    # throttle_margin * c_req (c_req = graylist_threshold / slow_weight).
+    # Margins close to 1 flood harder but risk graylisting through estimate
+    # error; the default leaves 20% headroom.
+    throttle_margin: float = 0.8
+    # sybil ids planted per victim px_pool row per heartbeat
+    px_poison_per_hb: int = 2
+
+    def validate(self, scenario: str | None = None) -> None:
+        if not (0.0 < self.throttle_margin < 1.0):
+            raise ValueError("throttle_margin must be in (0, 1) — at >= 1 "
+                             "the controller graylists itself, defeating "
+                             "the duty cycle")
+        if not (1 <= self.px_poison_per_hb <= PX_POOL_WIDTH):
+            raise ValueError(
+                f"px_poison_per_hb must be in [1, {PX_POOL_WIDTH}] "
+                f"(the px_pool width), got {self.px_poison_per_hb}")
+        if self.enabled and not (self.regraft or self.px_poison
+                                 or self.slot_race or self.duty_cycle):
+            raise ValueError("adaptive policy is enabled but every behavior "
+                             "is off — use enabled=False (the delegating "
+                             "path) instead of an armed no-op")
+        if self.enabled and scenario is not None \
+                and scenario not in ADAPTIVE_SCENARIOS:
+            raise ValueError(
+                f"adaptive policy composes with {ADAPTIVE_SCENARIOS} only "
+                f"(the graft-flood family), not scenario {scenario!r}: the "
+                "spam scenarios have no backoff/mesh loop to adapt to, "
+                "mimicry is already an adaptive policy, and rotation's "
+                "identity scrubs erase the controller's own estimate")
+
+
 @dataclass(frozen=True)
 class AdversaryParams:
     """Static (hashable -> jit static arg) attack-scenario parameters."""
@@ -121,8 +201,13 @@ class AdversaryParams:
     mimic_margin: float = 0.9
     # identity_rotation: heartbeats between identity scrubs
     rotation_period_hb: int = 4
+    # per-round adaptive controller policy (frozen, so the shared default
+    # instance keeps the dataclass a pure static key: every disabled config
+    # hashes/compares equal and lands on the same jit cache entry)
+    adaptive: AdaptivePolicy = AdaptivePolicy()
 
     def validate(self) -> None:
+        self.adaptive.validate(self.scenario)
         if self.scenario not in SCENARIOS:
             raise ValueError(
                 f"unknown scenario {self.scenario!r}; expected one of {SCENARIOS}")
@@ -254,9 +339,21 @@ def heartbeats_to_graylist(adv: AdversaryParams, params: SimParams) -> float:
     accruing only in rounds m*period+1 .. (m+1)*period-1, so the graylist
     engages iff the un-rotated budget fits strictly inside one rotation
     cycle; the boundary budget == period is conservatively reported inf
-    (engagement there depends on cycle alignment)."""
+    (engagement there depends on cycle alignment).
+
+    ADAPTIVE DUTY CYCLING (AdaptivePolicy.duty_cycle) returns inf by the
+    mimicry precedent: the controller throttles its own flood whenever one
+    more violation would push its predicted counter past throttle_margin *
+    c_req, and its estimate over-approximates the honest-side counter, so
+    the counter is clamped strictly below c_req forever — the budget is
+    adaptive in exactly the sense the arms race predicts: infinite. inf is
+    the finding, not a config error (run_campaign exempts it from the
+    inf-budget guard, like mimicry and rotation)."""
     if adv.slow_mimicry:
         return math.inf
+    if adv.adaptive.enabled and adv.adaptive.duty_cycle \
+            and params.slow_weight < 0.0:
+        return math.inf  # the controller never spends the budget
     if params.slow_weight >= 0.0:
         return math.inf  # thresholds_can_bind is False: defenses compiled out
     c_req = params.graylist_threshold / params.slow_weight
@@ -501,6 +598,149 @@ def attack_observables(
     }
 
 
+@partial(jax.jit, static_argnames=("params", "adv", "batch_factor"))
+def adaptive_round(
+    state: SimState,
+    ctrl: AdaptiveCtrl,
+    conns: jnp.ndarray,
+    rev: jnp.ndarray,
+    attacker: jnp.ndarray,
+    params: SimParams,
+    adv: AdversaryParams,
+    batch_factor: int = 1,
+    nbr_ok: jnp.ndarray | None = None,
+    edge_ok: jnp.ndarray | None = None,
+    hb_idx: jnp.ndarray | None = None,
+    att_sorted: jnp.ndarray | None = None,
+    n_att: jnp.ndarray | None = None,
+):
+    """One heartbeat of the ADAPTIVE attacker controller + honest defense
+    accounting, applied AFTER heartbeat_step (and after repair_round in the
+    recovery runner). The armed sibling of adversary_round: same masked
+    fixed-shape algebra, zero PRNG, but the attacker's round behavior is a
+    function of the controller carry `ctrl` instead of a constant mask.
+    Returns ((new_state, new_ctrl), obs); obs carries attack_observables
+    plus the adv_* controller channels (ops/telemetry.py).
+
+    `hb_idx`: the scan's 0-based round index (rotates the sybil-id schedule
+    of the PX poisoner); `att_sorted`/`n_att` are the scan-invariant sorted
+    cohort ids / cohort size the runners hoist (recomputed here when absent
+    so the round stays callable standalone).
+
+    State-machine per attacker row, per round:
+
+      1. PREDICT: next-round counter estimate = viol_est * slow_decay +
+         violation_penalty (what one more flood round would cost).
+      2. ACT or THROTTLE (duty_cycle): flood every valid edge iff the
+         prediction stays under throttle_margin * c_req; otherwise send
+         only LEGAL grafts this round (backoff expired, edge not meshed —
+         the regraft behavior, which accrues nothing).
+      3. OBSERVE: update viol_est from the attacker's OWN tx view — an
+         edge it grafted while its own backoff/mesh bits were set violated
+         on the honest side too (backoff writes are reciprocal everywhere
+         in the engine; the attacker's mesh bit over-approximates the
+         honest one since the flood sets it unilaterally, so the estimate
+         is conservative and the margin covers residual asymmetry).
+      4. POISON (px_poison, pool leaves live): plant px_poison_per_hb sybil
+         ids into the px_pool row of every honest peer adjacent to the
+         cohort, filling empty (-1) slots only — the same write discipline
+         as heartbeat's PX capture, consumed by repair_round's candidate
+         lattice. With repair fully inert the leaves are stripped and this
+         block compiles out (pool is None)."""
+    pol = adv.adaptive
+    if not pol.enabled:
+        raise ValueError("adaptive_round requires an armed AdaptivePolicy; "
+                         "the disabled path is run_attacked_heartbeats")
+    f32, i32 = jnp.float32, jnp.int32
+    t = state.t_ms
+    if nbr_ok is None:
+        nbr_ok = neighbor_pull_bool(
+            state.alive & state.subscribed, conns, rev, batch_factor)
+    valid = ((conns >= 0) & state.alive[:, None] & nbr_ok
+             & state.subscribed[:, None])
+    if edge_ok is not None:
+        valid = valid & edge_ok
+    att_row = attacker[:, None] & valid
+    n = conns.shape[0]
+    me = jnp.arange(n, dtype=i32)
+
+    # -- 1/2: score-aware duty cycle ------------------------------------
+    if pol.duty_cycle and params.slow_weight < 0.0:
+        c_req = f32(params.graylist_threshold / params.slow_weight)
+        predicted = ctrl.viol_est * f32(params.slow_decay) \
+            + f32(adv.violation_penalty)
+        act = attacker & (predicted < f32(pol.throttle_margin) * c_req)
+    else:
+        act = attacker
+
+    # -- graft set: full flood when acting, legal-only when throttled ----
+    legal = att_row & (state.backoff_until <= t) & ~state.mesh_mask
+    graft = att_row & act[:, None]
+    if pol.regraft:
+        graft = graft | legal
+    rx = reciprocal_pull_bool(graft, conns, rev, batch_factor)
+    violation = rx & ((state.backoff_until > t) | state.mesh_mask)
+    sc = state.score(params)
+    accept = rx & ~violation & (sc >= 0.0)
+    mesh = (state.mesh_mask | graft | accept) & valid
+    slow_penalty = state.slow_penalty + jnp.where(
+        violation, f32(adv.violation_penalty), 0.0)
+    grafts = state.grafts + graft.sum(axis=-1, dtype=i32)
+    grafts_rx = state.grafts_rx + rx.sum(axis=-1, dtype=i32)
+
+    # -- 3: controller estimate update (the attacker's own tx view) -----
+    self_viol = (graft & ((state.backoff_until > t)
+                          | state.mesh_mask)).any(axis=-1)
+    viol_est = ctrl.viol_est * f32(params.slow_decay) + jnp.where(
+        attacker & self_viol, f32(adv.violation_penalty), 0.0)
+    regrafts = ctrl.regrafts
+    if pol.regraft:
+        regrafts = regrafts + jnp.where(
+            attacker, legal.sum(axis=-1, dtype=i32), 0)
+    throttled_hb = ctrl.throttled_hb + (attacker & ~act).astype(i32)
+
+    # -- 4: PX poisoning (sybil answers to PX demand) --------------------
+    px_injected = ctrl.px_injected
+    pool = state.px_pool
+    extra = {}
+    if pol.px_poison and pool is not None:
+        if att_sorted is None:
+            att_sorted = jnp.sort(jnp.where(attacker, me, i32(n)))
+        if n_att is None:
+            n_att = attacker.sum()
+        att_nbr = neighbor_pull_bool(attacker, conns, rev, batch_factor)
+        victim = (~attacker & state.alive & state.subscribed
+                  & (att_nbr & valid).any(axis=-1))
+        hb = hb_idx if hb_idx is not None else 0
+        base = me + hb * i32(pol.px_poison_per_hb)
+        denom = jnp.maximum(n_att, 1)
+        for k in range(pol.px_poison_per_hb):
+            cand = att_sorted[(base + k) % denom]
+            empty = pool < 0
+            slot = jnp.argmax(empty, axis=-1)
+            do = victim & (n_att > 0) & (cand < n) & empty.any(axis=-1)
+            pool = pool.at[me, slot].set(
+                jnp.where(do, cand, pool[me, slot]))
+            px_injected = px_injected + do.astype(i32)
+        extra["px_pool"] = pool
+
+    new_state = state.replace(
+        mesh_mask=mesh, slow_penalty=slow_penalty,
+        grafts=grafts, grafts_rx=grafts_rx, **extra)
+    new_ctrl = AdaptiveCtrl(viol_est=viol_est, regrafts=regrafts,
+                            px_injected=px_injected,
+                            throttled_hb=throttled_hb)
+
+    from .telemetry import adaptive_observables
+
+    obs = attack_observables(new_state, conns, rev, attacker, params,
+                             batch_factor=batch_factor, valid=valid)
+    obs.update(adaptive_observables(
+        new_state, new_ctrl, attacker,
+        acting=act, violations=violation.sum(dtype=i32)))
+    return (new_state, new_ctrl), obs
+
+
 def run_attacked_heartbeats(
     state: SimState,
     conns: jnp.ndarray,
@@ -583,6 +823,98 @@ def _run_attacked_heartbeats(
         return s, obs
 
     return jax.lax.scan(body, state, xs, length=steps)
+
+
+def run_adaptive_heartbeats(
+    state: SimState,
+    conns: jnp.ndarray,
+    rev: jnp.ndarray,
+    out_mask: jnp.ndarray,
+    attacker: jnp.ndarray,
+    params: SimParams,
+    adv: AdversaryParams,
+    steps: int,
+    ctrl: AdaptiveCtrl | None = None,
+    batch_factor: int = 1,
+    telemetry=None,
+):
+    """The adaptive attack window: lax.scan of [heartbeat_step ->
+    adaptive_round] x steps with the per-attacker controller carry.
+
+    Disabled (`not adv.adaptive.enabled`) this IS run_attacked_heartbeats —
+    the same call, the same jit cache entry, bit-identical, zero extra PRNG
+    (the faults/telemetry/DHT delegation pattern); `ctrl` must be None and
+    the return is the base runner's (state, obs). Armed, `ctrl` defaults to
+    a fresh init_adaptive_ctrl(params.n) and the return widens to
+    ((state, ctrl), obs) — the run_dht_recovery_heartbeats carry
+    convention. Armed obs adds the adv_* controller channels; with repair
+    fully inert the 5 repair leaves are still stripped around the jit (the
+    PX poisoner compiles out: nothing could read the pool)."""
+    if not adv.adaptive.enabled:
+        if ctrl is not None:
+            raise ValueError("ctrl given but adv.adaptive is disabled — the "
+                             "disabled path delegates to "
+                             "run_attacked_heartbeats and carries none")
+        return run_attacked_heartbeats(
+            state, conns, rev, out_mask, attacker, params, adv, steps,
+            batch_factor, telemetry)
+    if telemetry is not None and not telemetry.enabled:
+        telemetry = None
+    if ctrl is None:
+        ctrl = init_adaptive_ctrl(params.n)
+    if repair_inert(params):
+        state, saved = strip_repair(state)
+        (out, ctrl), obs = _run_adaptive_heartbeats(
+            state, ctrl, conns, rev, out_mask, attacker, params, adv, steps,
+            batch_factor, telemetry)
+        return (restore_repair(out, saved), ctrl), obs
+    return _run_adaptive_heartbeats(
+        state, ctrl, conns, rev, out_mask, attacker, params, adv, steps,
+        batch_factor, telemetry)
+
+
+@partial(jax.jit, static_argnames=("params", "adv", "steps", "batch_factor",
+                                   "telemetry"))
+def _run_adaptive_heartbeats(
+    state: SimState,
+    ctrl: AdaptiveCtrl,
+    conns: jnp.ndarray,
+    rev: jnp.ndarray,
+    out_mask: jnp.ndarray,
+    attacker: jnp.ndarray,
+    params: SimParams,
+    adv: AdversaryParams,
+    steps: int,
+    batch_factor: int = 1,
+    telemetry=None,
+):
+    nbr_ok = None
+    if params.churn_down_per_hb == 0.0 and params.churn_up_per_hb == 0.0:
+        nbr_ok = neighbor_pull_bool(
+            state.alive & state.subscribed, conns, rev, batch_factor)
+
+    # the PX poisoner's sybil-id schedule is scan-invariant: hoist it
+    n = conns.shape[0]
+    att_sorted = jnp.sort(jnp.where(
+        attacker, jnp.arange(n, dtype=jnp.int32), jnp.int32(n)))
+    n_att = attacker.sum()
+
+    def body(carry, hb):
+        s, c = carry
+        s = heartbeat_step(s, conns, rev, out_mask, params,
+                           batch_factor=batch_factor, nbr_ok=nbr_ok)
+        (s, c), obs = adaptive_round(
+            s, c, conns, rev, attacker, params, adv,
+            batch_factor=batch_factor, nbr_ok=nbr_ok, hb_idx=hb,
+            att_sorted=att_sorted, n_att=n_att)
+        if telemetry is not None:
+            from .telemetry import telemetry_observables
+
+            obs.update(telemetry_observables(
+                s, conns, rev, params, telemetry, batch_factor=batch_factor))
+        return (s, c), obs
+
+    return jax.lax.scan(body, (state, ctrl), jnp.arange(steps), length=steps)
 
 
 def censorship_penalty_update(

@@ -59,10 +59,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .adversary import AdversaryParams, adversary_round, run_attacked_heartbeats
+from .adversary import (AdversaryParams, adaptive_round, adversary_round,
+                        run_adaptive_heartbeats, run_attacked_heartbeats)
 from .heartbeat import heartbeat_step
 from .pull import neighbor_pull_bool
-from .state import SimParams, SimState, repair_inert, restore_repair, strip_repair
+from .state import (SimParams, SimState, init_adaptive_ctrl, repair_inert,
+                    restore_repair, strip_repair)
 
 INF = jnp.float32(3.4e38)
 
@@ -184,6 +186,7 @@ def run_faulted_heartbeats(
     steps: int,
     batch_factor: int = 1,
     telemetry=None,
+    ctrl=None,
 ):
     """The fault-armed attack window: run_attacked_heartbeats with the
     fault schedule compiled into the scan body. `crash`/`side`/`spike` are
@@ -191,7 +194,16 @@ def run_faulted_heartbeats(
 
     Disabled (`not faults.enabled`) this IS run_attacked_heartbeats — the
     same call, the same jit cache entry — so the default path cannot drift
-    from the un-faulted engine by construction. Armed, the scan adds the
+    from the un-faulted engine by construction (with an armed
+    adv.adaptive the delegation target is run_adaptive_heartbeats, whose
+    own disabled path closes the chain back to the base runner). Armed
+    adaptive composes inside the faulted scan: the controller carry
+    (`ctrl`, defaulting to a fresh init_adaptive_ctrl) threads through
+    alongside the partition's frozen-edge bank, adaptive_round replaces
+    adversary_round, and the return widens to ((state, ctrl), obs) — a
+    crashed attacker's controller keeps its own estimate (the honest-side
+    counters its restart scrubbed are forgotten by the HONEST peers, so
+    the estimate stays conservative). Armed, the scan adds the
     per-family fault observables to the obs dict (present only when the
     family is armed; downstream reads use .get):
 
@@ -208,18 +220,33 @@ def run_faulted_heartbeats(
     if telemetry is not None and not telemetry.enabled:
         telemetry = None
     if not faults.enabled:
+        if adv.adaptive.enabled:
+            return run_adaptive_heartbeats(
+                state, conns, rev, out_mask, attacker, params, adv, steps,
+                ctrl=ctrl, batch_factor=batch_factor, telemetry=telemetry)
+        if ctrl is not None:
+            raise ValueError("ctrl given but the adaptive policy is "
+                             "disabled — the delegating path carries none")
         return run_attacked_heartbeats(
             state, conns, rev, out_mask, attacker, params, adv, steps,
             batch_factor, telemetry)
+    if adv.adaptive.enabled and ctrl is None:
+        ctrl = init_adaptive_ctrl(params.n)
+    if not adv.adaptive.enabled and ctrl is not None:
+        raise ValueError("ctrl given but the adaptive policy is disabled")
     if repair_inert(params):
         state, saved = strip_repair(state)
         out, obs = _run_faulted_heartbeats(
             state, conns, rev, out_mask, attacker, crash, side, spike,
-            params, adv, faults, steps, batch_factor, telemetry)
+            params, adv, faults, steps, batch_factor, telemetry, ctrl)
+        if adv.adaptive.enabled:
+            out, ctrl = out
+            return (restore_repair(out, saved), ctrl), obs
         return restore_repair(out, saved), obs
-    return _run_faulted_heartbeats(
+    out, obs = _run_faulted_heartbeats(
         state, conns, rev, out_mask, attacker, crash, side, spike,
-        params, adv, faults, steps, batch_factor, telemetry)
+        params, adv, faults, steps, batch_factor, telemetry, ctrl)
+    return out, obs
 
 
 @partial(jax.jit,
@@ -240,7 +267,15 @@ def _run_faulted_heartbeats(
     steps: int,
     batch_factor: int = 1,
     telemetry=None,
+    ctrl=None,
 ):
+    adaptive = adv.adaptive.enabled
+    if adaptive:
+        # the PX poisoner's sybil-id schedule is scan-invariant: hoist it
+        n_rows = conns.shape[0]
+        att_sorted = jnp.sort(jnp.where(
+            attacker, jnp.arange(n_rows, dtype=jnp.int32), jnp.int32(n_rows)))
+        n_att = attacker.sum()
     nbr_ok = None
     if (not faults.crash and params.churn_down_per_hb == 0.0
             and params.churn_up_per_hb == 0.0):
@@ -294,8 +329,13 @@ def _run_faulted_heartbeats(
                 jnp.zeros_like(frozen))
 
     def body(carry, hb):
-        if faults.partition:
+        frozen = c = None
+        if faults.partition and adaptive:
+            s, c, frozen = carry
+        elif faults.partition:
             s, frozen = carry
+        elif adaptive:
+            s, c = carry
         else:
             s = carry
         if faults.crash:
@@ -313,9 +353,15 @@ def _run_faulted_heartbeats(
         s = heartbeat_step(s, conns, rev, out_mask, params,
                            batch_factor=batch_factor, nbr_ok=nbr_ok,
                            edge_ok=edge_ok)
-        s, obs = adversary_round(s, conns, rev, attacker, params, adv,
-                                 batch_factor=batch_factor, nbr_ok=nbr_ok,
-                                 edge_ok=edge_ok, hb_idx=hb)
+        if adaptive:
+            (s, c), obs = adaptive_round(
+                s, c, conns, rev, attacker, params, adv,
+                batch_factor=batch_factor, nbr_ok=nbr_ok, edge_ok=edge_ok,
+                hb_idx=hb, att_sorted=att_sorted, n_att=n_att)
+        else:
+            s, obs = adversary_round(s, conns, rev, attacker, params, adv,
+                                     batch_factor=batch_factor, nbr_ok=nbr_ok,
+                                     edge_ok=edge_ok, hb_idx=hb)
         if faults.spike:
             # push the spiked cohort's uplink clock forward: the next
             # publish serializes behind the spike, exactly like an
@@ -339,13 +385,24 @@ def _run_faulted_heartbeats(
 
             obs.update(telemetry_observables(
                 s, conns, rev, params, telemetry, batch_factor=batch_factor))
-        return ((s, frozen) if faults.partition else s), obs
+        if faults.partition and adaptive:
+            return (s, c, frozen), obs
+        if faults.partition:
+            return (s, frozen), obs
+        if adaptive:
+            return (s, c), obs
+        return s, obs
 
-    if faults.partition:
+    xs = jnp.arange(steps)
+    if faults.partition and adaptive:
+        carry0 = (state, ctrl, jnp.zeros_like(state.mesh_mask))
+        (state, ctrl, _), obs = jax.lax.scan(body, carry0, xs, length=steps)
+    elif faults.partition:
         carry0 = (state, jnp.zeros_like(state.mesh_mask))
-        (state, _), obs = jax.lax.scan(
-            body, carry0, jnp.arange(steps), length=steps)
+        (state, _), obs = jax.lax.scan(body, carry0, xs, length=steps)
+    elif adaptive:
+        (state, ctrl), obs = jax.lax.scan(body, (state, ctrl), xs,
+                                          length=steps)
     else:
-        state, obs = jax.lax.scan(
-            body, state, jnp.arange(steps), length=steps)
-    return state, obs
+        state, obs = jax.lax.scan(body, state, xs, length=steps)
+    return ((state, ctrl) if adaptive else state), obs

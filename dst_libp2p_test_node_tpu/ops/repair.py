@@ -34,11 +34,18 @@ The contract that keeps this sound:
     publish (Simulator.rebind_graph: valid_edge, lat_edge/loss_edge,
     answer tables all index the mutated conns/rev).
 
-Non-adaptive adversary assumption: `run_recovery_heartbeats` passes
-actor=~attacker, i.e. attackers do NOT run the repair controller to worm
-back into the mesh after being evicted (their per-scenario behavior is the
-whole attack model, ops/adversary.py). Adaptive adversaries that abuse
-PX/re-dial are the documented follow-on (ROADMAP).
+Adversary models. The STATIC runners (`run_recovery_heartbeats`,
+`run_dht_recovery_heartbeats`) pass actor=~attacker: attackers do NOT run
+the repair controller to worm back into the mesh after eviction, and on
+the DHT leg their identities refuse inbound dials (refuse=attacker) — the
+weakest opponent. `run_adaptive_recovery_heartbeats` is the arms-race
+runner (ops/adversary.AdaptivePolicy): with slot_race armed the attacker
+cohort runs the dial controller too AND accepts inbound dials (a sybil
+that wants your slot completes the handshake), its controller re-grafts
+at backoff expiry and re-poisons the PX pool after every repair pass, so
+the candidate lattice honest repair draws from is contested every round.
+Disabled, it literally delegates to the static runner (same jit cache
+entry, bit-identical, zero extra PRNG).
 """
 
 from __future__ import annotations
@@ -50,9 +57,9 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
-from .adversary import attack_observables
+from .adversary import (AdversaryParams, adaptive_round, attack_observables)
 from .heartbeat import heartbeat_step
-from .state import SimParams, SimState
+from .state import (AdaptiveCtrl, SimParams, SimState, init_adaptive_ctrl)
 
 INF = jnp.float32(3.4e38)
 
@@ -447,3 +454,120 @@ def run_dht_recovery_heartbeats(
     return _run_dht_recovery_heartbeats(
         state, conns, rev, out_mask, attacker, dht_pool, params, steps,
         publisher, batch_factor, telemetry)
+
+
+@partial(jax.jit,
+         static_argnames=("params", "adv", "steps", "publisher",
+                          "batch_factor", "telemetry"))
+def _run_adaptive_recovery_heartbeats(state, ctrl, conns, rev, out_mask,
+                                      attacker, dht_pool, params, adv,
+                                      steps, publisher, batch_factor,
+                                      telemetry):
+    pol = adv.adaptive
+    # slot_race: the cohort runs the dial controller too, and its sybil
+    # identities COMPLETE inbound handshakes (it wants the slot) — the
+    # static model's refuse=attacker flips off
+    actor = None if pol.slot_race else ~attacker
+    refuse = None if pol.slot_race else (
+        attacker if dht_pool is not None else None)
+    # the PX poisoner's sybil-id schedule is scan-invariant even though the
+    # graph is not: hoist it (nbr_ok must NOT hoist — conns is carried)
+    n = conns.shape[0]
+    att_sorted = jnp.sort(jnp.where(
+        attacker, jnp.arange(n, dtype=jnp.int32), jnp.int32(n)))
+    n_att = attacker.sum()
+
+    def body(carry, hb):
+        if dht_pool is not None:
+            s, c, cn, rv, om, pool = carry
+        else:
+            s, c, cn, rv, om = carry
+            pool = None
+        ev0 = s.evictions.sum()
+        px0 = s.px_grafts.sum()
+        rd0 = s.redials.sum()
+        s = heartbeat_step(s, cn, rv, om, params, batch_factor=batch_factor)
+        fired = repair_round(
+            s, cn, rv, om, params, actor=actor, batch_factor=batch_factor,
+            dht_pool=pool, refuse=refuse)
+        if dht_pool is not None:
+            s, cn, rv, om, pool = fired
+        else:
+            s, cn, rv, om = fired
+        # the controller reacts AFTER the repair pass: re-grafts the slots
+        # eviction just freed, re-poisons the pool repair just consumed
+        (s, c), obs = adaptive_round(
+            s, c, cn, rv, attacker, params, adv,
+            batch_factor=batch_factor, hb_idx=hb,
+            att_sorted=att_sorted, n_att=n_att)
+        f32 = jnp.float32
+        nbr = cn[publisher]
+        att_n = (nbr >= 0) & attacker[jnp.clip(nbr, 0)]
+        obs["pub_honest_degree"] = (
+            s.mesh_mask[publisher] & (nbr >= 0) & ~att_n).sum().astype(f32)
+        obs["evictions"] = (s.evictions.sum() - ev0).astype(f32)
+        obs["px_grafts"] = (s.px_grafts.sum() - px0).astype(f32)
+        obs["redials"] = (s.redials.sum() - rd0).astype(f32)
+        if dht_pool is not None:
+            obs["dht_pool_left"] = (pool >= 0).sum().astype(f32)
+            obs["starve_max"] = s.starve_hb.max().astype(f32)
+        if telemetry is not None:
+            from .telemetry import telemetry_observables
+
+            obs.update(telemetry_observables(
+                s, cn, rv, params, telemetry, batch_factor=batch_factor))
+        carry = ((s, c, cn, rv, om, pool) if dht_pool is not None
+                 else (s, c, cn, rv, om))
+        return carry, obs
+
+    carry0 = ((state, ctrl, conns, rev, out_mask, dht_pool)
+              if dht_pool is not None
+              else (state, ctrl, conns, rev, out_mask))
+    return jax.lax.scan(body, carry0, jnp.arange(steps), length=steps)
+
+
+def run_adaptive_recovery_heartbeats(
+    state: SimState,
+    conns: jnp.ndarray,
+    rev: jnp.ndarray,
+    out_mask: jnp.ndarray,
+    attacker: jnp.ndarray,
+    params: SimParams,
+    steps: int,
+    adv: AdversaryParams | None = None,
+    ctrl: AdaptiveCtrl | None = None,
+    dht_pool: jnp.ndarray | None = None,
+    publisher: int = 0,
+    batch_factor: int = 1,
+    telemetry=None,
+):
+    """The ARMS-RACE recovery window: the repair controller heals the mesh
+    while the adaptive adversary controller (ops/adversary.adaptive_round)
+    contests every round of it — racing honest dialers for freed slots
+    (actor=everyone, refuse=None: sybils dial AND accept), re-grafting
+    edges the moment their backoff expires, re-poisoning the PX candidate
+    pool right after repair consumes from it, and duty-cycling its own
+    violation rate so the graylist never disarms it.
+
+    Disabled (`adv` None or adv.adaptive.enabled False) this IS
+    run_dht_recovery_heartbeats — the same call, the same jit cache entry,
+    bit-identical, zero extra PRNG — which itself delegates to
+    run_recovery_heartbeats when `dht_pool` is None; `ctrl` must be None
+    then. Armed, the controller carry threads through the scan and the
+    return widens to ((state, ctrl, conns, rev, out_mask[, dht_pool]),
+    obs) with the adv_* channels joining the recovery obs."""
+    if adv is None or not adv.adaptive.enabled:
+        if ctrl is not None:
+            raise ValueError("ctrl given but the adaptive policy is "
+                             "disabled — the delegating path carries none")
+        return run_dht_recovery_heartbeats(
+            state, conns, rev, out_mask, attacker, params, steps,
+            dht_pool=dht_pool, publisher=publisher,
+            batch_factor=batch_factor, telemetry=telemetry)
+    if telemetry is not None and not telemetry.enabled:
+        telemetry = None
+    if ctrl is None:
+        ctrl = init_adaptive_ctrl(params.n)
+    return _run_adaptive_recovery_heartbeats(
+        state, ctrl, conns, rev, out_mask, attacker, dht_pool, params, adv,
+        steps, publisher, batch_factor, telemetry)

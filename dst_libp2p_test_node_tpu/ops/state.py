@@ -378,6 +378,44 @@ def restore_repair(state: SimState, saved: dict) -> SimState:
     return state.replace(**saved)
 
 
+# Per-attacker controller leaves for the ADAPTIVE adversary (ops/adversary.py
+# AdaptivePolicy). These are the strip_repair discipline taken to its limit:
+# instead of riding SimState and being excised host-side when inert, the
+# controller is a SEPARATE pytree threaded through the armed scan carry
+# (run_adaptive_heartbeats / run_adaptive_recovery_heartbeats) and never
+# materialized at all on the disabled path — the delegating wrappers call the
+# base runners with the exact argument list, so the default trace cannot grow
+# a dead carry leaf by construction (the r05 regression class).
+ADAPTIVE_LEAVES = ("viol_est", "regrafts", "px_injected", "throttled_hb")
+
+
+@struct.dataclass
+class AdaptiveCtrl:
+    """On-device adaptive-attacker controller state, (N,) per peer (honest
+    rows stay zero). `viol_est` is the attacker's own running estimate of
+    the worst honest-side slow_penalty counter any of its edges carries —
+    updated from its OWN tx view each round (backoff is symmetric on both
+    endpoints of an edge; the attacker's mesh bit over-approximates the
+    honest one, so the estimate is conservative: est >= max_j counter_j and
+    the duty cycle never overshoots the graylist floor). The other leaves
+    are attacker-side telemetry counters (ops/telemetry.py channels)."""
+
+    viol_est: jnp.ndarray      # (N,) f32: self-estimated violation counter
+    regrafts: jnp.ndarray      # (N,) i32: backoff-expiry re-graft attempts
+    px_injected: jnp.ndarray   # (N,) i32: sybil ids planted in px_pool rows
+    throttled_hb: jnp.ndarray  # (N,) i32: rounds spent duty-cycled OFF
+
+
+def init_adaptive_ctrl(n: int) -> AdaptiveCtrl:
+    """Zeroed controller carry for a fresh trial window."""
+    return AdaptiveCtrl(
+        viol_est=jnp.zeros((n,), dtype=jnp.float32),
+        regrafts=jnp.zeros((n,), dtype=jnp.int32),
+        px_injected=jnp.zeros((n,), dtype=jnp.int32),
+        throttled_hb=jnp.zeros((n,), dtype=jnp.int32),
+    )
+
+
 def graph_arrays(graph) -> dict:
     """Move a ConnGraph's arrays to device once (jnp constants per epoch)."""
     return {

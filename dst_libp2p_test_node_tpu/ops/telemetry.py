@@ -158,6 +158,51 @@ def telemetry_observables(
     }
 
 
+def adaptive_observables(
+    state: SimState,
+    ctrl,
+    attacker: jnp.ndarray,
+    acting: jnp.ndarray,
+    violations: jnp.ndarray,
+) -> dict:
+    """Attacker-side controller channels for the ADAPTIVE adversary
+    (ops/adversary.py adaptive_round) — the recorder discipline applies:
+    pure reductions over state the scan body already holds, no PRNG, no
+    state write; only the armed scan's OUTPUT grows these keys. All f32
+    scalars:
+
+      adv_violation_rate    protocol violations accrued THIS round per
+                            attacker (the live rate the duty cycle is
+                            throttling; ~0 while the controller coasts)
+      adv_throttled_frac    fraction of the cohort duty-cycled OFF this
+                            round
+      adv_regraft_attempts  cumulative backoff-expiry re-grafts sent
+      adv_px_sybil_frac     fraction of OCCUPIED honest px_pool entries
+                            holding attacker ids — how poisoned the repair
+                            candidate lattice currently is (0.0 when the
+                            repair leaves are stripped: nothing reads the
+                            pool either)
+
+    `ctrl` is the ops/state.AdaptiveCtrl carry; `acting` the (N,) bool
+    flood mask the duty cycle chose; `violations` the round's scalar
+    violation count."""
+    f32 = jnp.float32
+    n_att = jnp.maximum(attacker.sum(), 1).astype(f32)
+    if state.px_pool is not None:
+        honest = ~attacker & state.alive & state.subscribed
+        occ = (state.px_pool >= 0) & honest[:, None]
+        sybil = occ & attacker[jnp.clip(state.px_pool, 0)]
+        px_sybil_frac = sybil.sum() / jnp.maximum(occ.sum(), 1).astype(f32)
+    else:
+        px_sybil_frac = f32(0.0)
+    return {
+        "adv_violation_rate": violations.astype(f32) / n_att,
+        "adv_throttled_frac": (attacker & ~acting).sum() / n_att,
+        "adv_regraft_attempts": ctrl.regrafts.sum().astype(f32),
+        "adv_px_sybil_frac": px_sybil_frac,
+    }
+
+
 def run_recorded_heartbeats(
     state: SimState,
     conns: jnp.ndarray,

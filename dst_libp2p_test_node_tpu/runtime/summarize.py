@@ -188,6 +188,31 @@ def _cell(v, fmt: str = "g") -> str:
     return str(v)
 
 
+def _mcell(v, fmt: str = "g") -> str:
+    """Milestone cell: the -1 sentinel ("never reached the milestone" /
+    "family not armed", the recovery_time_ms convention) renders as an em
+    dash instead of a misleading negative number. Only for columns whose
+    legitimate range is non-negative — scores stay on _cell."""
+    if isinstance(v, (int, float)) and not isinstance(v, bool) and v < 0:
+        return "—"
+    return _cell(v, fmt)
+
+
+def _agg(vals, fmt: str = "g", milestone: bool = False) -> str:
+    """Mean over one aggregate-row column. Milestone columns drop their -1
+    sentinels first — a trial that never reached the milestone (or never
+    armed the family, e.g. every zero-attacker trial) must not drag the
+    average negative; all-sentinel columns render as the dash."""
+    xs = [v for v in vals if v is not None]
+    if milestone:
+        xs = [v for v in xs if v >= 0]
+        if not xs:
+            return "—"
+    if not xs:
+        return "-"
+    return format(sum(xs) / len(xs), fmt)
+
+
 def report_campaign(campaign: dict) -> str:
     """Text report for an adversarial campaign (runtime/campaign.py
     CampaignResult.to_dict). Duck-typed on the dict so `summarize`-side
@@ -207,26 +232,62 @@ def report_campaign(campaign: dict) -> str:
             _cell(t["honest_coverage"], ".4f"),
             _cell(t["latency_p50_ms"], ".1f"),
             _cell(t["latency_inflation"], ".3f"),
-            str(t["hb_to_graylist"]), str(t["mesh_recovery_hb"]),
+            # milestone columns: the -1 "never reached / not armed"
+            # sentinel renders as an em dash (_mcell)
+            _mcell(t["hb_to_graylist"]), _mcell(t["mesh_recovery_hb"]),
             _cell(t["attacker_score_final"], ".1f"),
             # repair columns default for pre-repair artifacts (duck-typed:
             # an old JSON report still renders)
             str(t.get("mesh_evictions_total", 0)),
             str(t.get("px_grafts_total", 0)),
             str(t.get("redials_total", 0)),
-            _cell(t.get("recovery_time_ms", -1.0), ".1f"),
+            _mcell(t.get("recovery_time_ms", -1.0), ".1f"),
             # fault-injection columns (ops/faults.py); -1 = fault family
             # not scheduled in this trial, same convention as recover_ms
-            _cell(t.get("heal_time_ms", -1.0), ".1f"),
-            str(t.get("post_churn_reconvergence_hb", -1)),
-            _cell(t.get("coverage_under_partition", -1.0), ".3f"),
+            _mcell(t.get("heal_time_ms", -1.0), ".1f"),
+            _mcell(t.get("post_churn_reconvergence_hb", -1)),
+            _mcell(t.get("coverage_under_partition", -1.0), ".3f"),
             # flight-recorder curve milestones (ops/telemetry.py); -1 =
             # recorder off or the curve never crossed inside the windows
-            str(t.get("coverage90_hb", -1)),
-            str(t.get("score_cross_hb", -1)),
+            _mcell(t.get("coverage90_hb", -1)),
+            _mcell(t.get("score_cross_hb", -1)),
             # cross-protocol DHT adversary (ops/dht_adversary.py); -1 =
             # DHT not armed for this trial
-            _cell(t.get("rtable_poison_frac", -1.0), ".4f"),
+            _mcell(t.get("rtable_poison_frac", -1.0), ".4f"),
+        ]))
+    # one aggregate (mean) row per fraction; _agg excludes milestone
+    # sentinels so zero-attacker and never-recovered trials stop dragging
+    # the averages negative
+    by_frac: dict = {}
+    for t in campaign["trials"]:
+        by_frac.setdefault(t["fraction"], []).append(t)
+    for f in sorted(by_frac):
+        ts = by_frac[f]
+
+        def g(k, d=None, ts=ts):
+            return [t.get(k, d) for t in ts]
+
+        out.append(" \t ".join([
+            f"mean {_cell(f)}", f"n={len(ts)}",
+            _agg(g("attackers"), ".1f"),
+            _agg(g("honest_coverage"), ".4f"),
+            _agg(g("latency_p50_ms"), ".1f"),
+            _agg(g("latency_inflation"), ".3f"),
+            _agg(g("hb_to_graylist"), ".1f", milestone=True),
+            _agg(g("mesh_recovery_hb"), ".1f", milestone=True),
+            _agg(g("attacker_score_final"), ".1f"),
+            _agg(g("mesh_evictions_total", 0), ".1f"),
+            _agg(g("px_grafts_total", 0), ".1f"),
+            _agg(g("redials_total", 0), ".1f"),
+            _agg(g("recovery_time_ms", -1.0), ".1f", milestone=True),
+            _agg(g("heal_time_ms", -1.0), ".1f", milestone=True),
+            _agg(g("post_churn_reconvergence_hb", -1), ".1f",
+                 milestone=True),
+            _agg(g("coverage_under_partition", -1.0), ".3f",
+                 milestone=True),
+            _agg(g("coverage90_hb", -1), ".1f", milestone=True),
+            _agg(g("score_cross_hb", -1), ".1f", milestone=True),
+            _agg(g("rtable_poison_frac", -1.0), ".4f", milestone=True),
         ]))
     out.append(
         f"Trials :  {len(campaign['trials'])}  trials/s :  "
@@ -243,4 +304,39 @@ def report_campaign(campaign: dict) -> str:
                 f"  quarantined  frac {_cell(q.get('fraction'))}  seeds "
                 f"{q.get('seeds')}  failures {q.get('failures')}  "
                 f"{q.get('error', '')}")
+    return "\n".join(out) + "\n"
+
+
+def report_defense_sweep(sweep: dict) -> str:
+    """Text report for a run_defense_sweep artifact (runtime/campaign.py):
+    one row per swept defense config with its objective aggregates and
+    membership of the Pareto front / beats-default sets. Duck-typed on
+    the artifact dict like report_campaign, so a saved JSON artifact
+    reloads straight into this."""
+    obj = sweep.get("objectives", {})
+    hdr = (f"Defense sweep :  {sweep['scenario']}  Peers :  "
+           f"{sweep['network_size']}  objectives :  "
+           + "  ".join(f"{k}({v})" for k, v in obj.items()))
+    cols = ("idx \t d_low \t d \t d_high \t slow_w \t coverage "
+            "\t bandwidth_B \t recover_ms \t recovered \t front "
+            "\t beats_default")
+    out = [hdr, cols]
+    front = set(sweep.get("pareto", ()))
+    beats = set(sweep.get("beats_default", ()))
+    for i, r in enumerate(sweep["configs"]):
+        out.append(" \t ".join([
+            f"{i}{'*' if r.get('is_default') else ''}",
+            str(r["d_low"]), str(r["d"]), str(r["d_high"]),
+            _cell(r["slow_peer_penalty_weight"]),
+            _cell(r["coverage"], ".4f"),
+            _cell(r["bandwidth_bytes"], ".0f"),
+            _mcell(r["recovery_time_ms"], ".1f"),
+            _cell(r["recovered_frac"], ".2f"),
+            "yes" if i in front else "",
+            "yes" if i in beats else "",
+        ]))
+    out.append(
+        f"Configs :  {len(sweep['configs'])} (* = default)  front :  "
+        f"{sorted(front)}  beats default :  {sorted(beats)}  wall :  "
+        f"{_cell(sweep.get('wall_s'), '.2f')} s")
     return "\n".join(out) + "\n"
