@@ -69,6 +69,12 @@ The registered surface mirrors the BENCH hot paths exactly:
                           (ops/dht_adversary.py): repair armed, per-trial
                           poisoned discovery shortlists sharded over the
                           same nested grid and consumed by the redial path
+  heartbeat/fused_round   the fused mega-round scan (ISSUE 16): one scan
+                          over publish rounds, heartbeat burst + exact
+                          publish in the body — all 6 phase conds survive
+  native/score_update     the fused Pallas scoring-update kernel in
+                          interpret mode (the jaxpr carries the real
+                          pallas_call on every backend)
 """
 
 from __future__ import annotations
@@ -351,6 +357,47 @@ def _telemetry_attack_spec() -> TraceSpec:
         args=(state, a["conns"], a["rev"], a["out_mask"], att),
         kwargs=dict(params=params, adv=AdversaryParams(), steps=4,
                     telemetry=TelemetryParams(record=True)))
+
+
+def _fused_rounds_spec() -> TraceSpec:
+    import jax.numpy as jnp
+
+    from ..ops.disseminate import run_fused_rounds
+
+    # fused_rounds=True arms the mega-round scan (the disabled path is
+    # intentionally NOT registered here — it IS the phase-split chain's
+    # cache entries, already audited above)
+    g, params, state, a, (stage, lat, bw) = _single_topic(fused_rounds=True)
+    return TraceSpec(
+        fn=run_fused_rounds,
+        args=(state, a["conns"], a["rev"], stage, lat, bw, a["out_mask"],
+              jnp.arange(3, 6, dtype=jnp.int32)),
+        kwargs=dict(params=params, payload_bytes=15000, hb_per_round=2))
+
+
+@functools.lru_cache(maxsize=None)
+def _score_update_fn(params):
+    """One shared jitted wrapper per params: contract builds must return
+    the SAME callable so the second measure_retraces call is a pure cache
+    hit (a per-build closure would retrace by construction)."""
+    import jax
+
+    from ..native.score_update import score_update
+
+    return jax.jit(functools.partial(score_update, params=params,
+                                     interpret=True))
+
+
+def _score_update_spec() -> TraceSpec:
+    import jax.numpy as jnp
+
+    g, params, state, a, _ = _single_topic(slow_weight=-10.0)
+    n, c = params.n, params.capacity
+    fmd = (jnp.arange(n * c, dtype=jnp.float32).reshape(n, c) % 13) * 0.3
+    slow = (jnp.arange(n * c, dtype=jnp.float32).reshape(n, c) % 7) * 0.2
+    return TraceSpec(
+        fn=_score_update_fn(params),
+        args=(fmd, slow, 0.9, 0.8))
 
 
 def _kad_spec() -> TraceSpec:
@@ -702,6 +749,35 @@ def default_contracts() -> list[EntrypointContract]:
             notes="attack window with the recorder armed via the static "
                   "telemetry kwarg — same cond census as the bare window; "
                   "the tel_* channels are pure reductions"),
+        EntrypointContract(
+            name="heartbeat/fused_round",
+            build=_fused_rounds_spec,
+            expected_conds=6,
+            feedback=[(_first_out, _state_arg_of)],
+            notes="the fused mega-round scan (ISSUE 16, ARCHITECTURE §18): "
+                  "one lax.scan over publish rounds whose body is the "
+                  "heartbeat burst + the exact publish — run_heartbeats' 4 "
+                  "steady-state skips plus disseminate/cold's 2 conds "
+                  "(repair + serial-certificate fallback) must all survive "
+                  "INSIDE the fused scan body; the returned state feeds the "
+                  "next call aval-stable, and the whole chain must stay one "
+                  "cache entry per shape (the disabled path literally IS "
+                  "the phase-split chain and is audited via its own "
+                  "contracts)"),
+        EntrypointContract(
+            name="native/score_update",
+            build=_score_update_spec,
+            expected_conds=None,
+            feedback=[(lambda out: out[0], lambda spec: spec.args[0]),
+                      (lambda out: out[1], lambda spec: spec.args[1])],
+            notes="the fused Pallas scoring-update kernel "
+                  "(native/score_update.py), traced in interpret mode so "
+                  "the audited jaxpr contains the real pallas_call on any "
+                  "backend; the decayed counters feed back aval-stable "
+                  "(they are the next round's inputs), and the XLA "
+                  "reference score_update_xla is the correctness target: "
+                  "counters bitwise, score to ulp-level FMA tolerance "
+                  "(tests/test_score_kernel.py)"),
         EntrypointContract(
             name="kad/find_node",
             build=_kad_spec,

@@ -1,0 +1,189 @@
+"""Pallas fused scoring-update kernel, behind a runtime capability probe.
+
+The heartbeat scan defers the per-round counter decay into two carried
+scalars and materializes it once post-scan (ops/heartbeat._apply_decay on
+`fmd` and `slow_penalty`), after which every consumer immediately re-reads
+the decayed counters through SimState.score — a second full (N, C) HBM
+round-trip for a few flops. This kernel fuses the two: one pass over the
+row blocks applies both decays (with the flush-to-zero floor) AND emits the
+weighted score, so the counters stream through VMEM exactly once.
+
+Same discipline as native/vmem_gather.py, the first kernel behind this
+pattern: whether the Mosaic toolchain compiles THIS formulation is decided
+at runtime by `score_kernel_available()` — a one-shot cached probe that
+compiles a miniature instance on the real backend and compares it against
+the plain-XLA reference (`score_update_xla`, which is bit-for-bit the
+heartbeat/_apply_decay + SimState.score composition). Any failure makes the
+probe False and callers keep the XLA formulation, so CPU CI and older
+toolchains stay green by construction. `DST_PALLAS_SCORE=0` forces the
+kernel off; `=1` forces the probe to raise instead of degrade.
+
+CPU correctness of the kernel body itself is tested with `interpret=True`
+(tests/test_score_kernel.py), which runs the Pallas program without Mosaic.
+The row-block size consults the microbench autotuner's tuned.json
+(native/tuned.py) before the largest-dividing-power-of-two heuristic.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+
+from .tuned import tuned_block_rows
+
+_ENV = "DST_PALLAS_SCORE"
+
+# three f32 (block, C) tiles live per grid step (two counters in, score
+# out, counters updated in place of their input tiles); 512 rows x 64
+# slots x 5 arrays = 640 KB — a small fraction of a core's ~16 MB VMEM
+_MAX_BLOCK = 512
+
+
+def _block_rows(n_rows: int) -> int:
+    """Tuned row block when tuned.json has a valid entry, else the largest
+    power-of-two <= _MAX_BLOCK dividing n_rows (grid steps must tile the
+    array exactly)."""
+    tuned = tuned_block_rows("score_update", n_rows, _MAX_BLOCK)
+    if tuned is not None:
+        return tuned
+    b = 1
+    while b < _MAX_BLOCK and n_rows % (b * 2) == 0:
+        b *= 2
+    return b
+
+
+@functools.cache
+def _compiled(n_rows: int, cap: int, fmd_weight: float, slow_weight: float,
+              fmd_cap: float, decay_to_zero: float, interpret: bool,
+              block_rows: int | None = None):
+    """Build the pallas_call for one (rows, cap) shape + weight constants.
+    Raises whatever Pallas/Mosaic raises — callers go through the probe.
+    `block_rows` overrides the tuned/heuristic block (the microbench
+    sweep's knob); it must tile n_rows exactly."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    block = block_rows if block_rows is not None else _block_rows(n_rows)
+    if n_rows % block != 0:
+        raise ValueError(f"block_rows {block} does not tile {n_rows} rows")
+    if not interpret and block < 8:
+        # sub-tile row blocks can't meet the (8, 128) f32 tiling floor
+        raise ValueError(f"row count {n_rows} leaves block {block} < 8")
+
+    def kernel(sc_ref, fmd_ref, slow_ref, fmd_out, slow_out, score_out):
+        # the (2,) decay-scale vector is VMEM-resident for every grid step
+        sc = sc_ref[...]
+        f = fmd_ref[...] * sc[0]
+        s = slow_ref[...] * sc[1]
+        f = jnp.where(f < decay_to_zero, 0.0, f)
+        s = jnp.where(s < decay_to_zero, 0.0, s)
+        fmd_out[...] = f
+        slow_out[...] = s
+        score_out[...] = (fmd_weight * jnp.minimum(f, fmd_cap)
+                          + slow_weight * s)
+
+    row_spec = pl.BlockSpec((block, cap), lambda i: (i, 0),
+                            memory_space=pltpu.VMEM)
+    return pl.pallas_call(
+        kernel,
+        grid=(n_rows // block,),
+        in_specs=[
+            pl.BlockSpec((2,), lambda i: (0,), memory_space=pltpu.VMEM),
+            row_spec,
+            row_spec,
+        ],
+        out_specs=[row_spec, row_spec, row_spec],
+        out_shape=[jax.ShapeDtypeStruct((n_rows, cap), jnp.float32)] * 3,
+        interpret=interpret,
+    )
+
+
+def score_update(fmd, slow_penalty, f_scale, s_scale, params, *,
+                 interpret: bool = False, block_rows: int | None = None):
+    """(decayed fmd, decayed slow_penalty, score) in one fused pass.
+
+    `f_scale`/`s_scale` are the heartbeat scan's carried decay scalars
+    (traced); the weight/cap/flush constants come from `params` and bake
+    into the compiled kernel like every other SimParams static.
+    `block_rows` is the microbench sweep's explicit row-block override;
+    production callers leave it None (tuned.json/heuristic)."""
+    scales = jnp.stack([jnp.asarray(f_scale, jnp.float32),
+                        jnp.asarray(s_scale, jnp.float32)])
+    return _compiled(
+        fmd.shape[0], fmd.shape[1], float(params.fmd_weight),
+        float(params.slow_weight), float(params.fmd_cap),
+        float(params.decay_to_zero), interpret, block_rows,
+    )(scales, fmd.astype(jnp.float32), slow_penalty.astype(jnp.float32))
+
+
+def score_update_best(fmd, slow_penalty, f_scale, s_scale, params):
+    """The dispatch point consumers call (parallel/exchange._src_gather's
+    routing pattern): the Pallas kernel when the one-shot capability probe
+    passes on this backend, the plain-XLA formulation everywhere else."""
+    if score_kernel_available():
+        return score_update(fmd, slow_penalty, f_scale, s_scale, params)
+    return score_update_xla(fmd, slow_penalty, f_scale, s_scale, params)
+
+
+def score_update_xla(fmd, slow_penalty, f_scale, s_scale, params):
+    """The plain-XLA reference and fallback: literally the
+    ops/heartbeat._apply_decay composition followed by SimState.score, so
+    the kernel's correctness target IS the production formula."""
+    f = fmd * f_scale
+    s = slow_penalty * s_scale
+    f = jnp.where(f < params.decay_to_zero, 0.0, f)
+    s = jnp.where(s < params.decay_to_zero, 0.0, s)
+    score = (params.fmd_weight * jnp.minimum(f, params.fmd_cap)
+             + params.slow_weight * s)
+    return f, s, score
+
+
+def _probe() -> bool:
+    """Compile + run a miniature instance on the real backend and check it
+    against the XLA reference. True only if everything compiles AND the
+    counters match bitwise (the score read carries an ulp-level FMA
+    tolerance)."""
+    if jax.default_backend() != "tpu":
+        # the kernel exists to exploit TPU VMEM; interpret mode on CPU is
+        # a test vehicle, not a win
+        return False
+    try:
+        from ..ops.state import SimParams
+
+        n, c = 256, 8
+        params = SimParams(n=n, capacity=c, slow_weight=-10.0)
+        fmd = (jnp.arange(n * c, dtype=jnp.float32).reshape(n, c) % 13) * 0.3
+        slow = (jnp.arange(n * c, dtype=jnp.float32).reshape(n, c) % 7) * 0.2
+        want = score_update_xla(fmd, slow, 0.9, 0.8, params)
+        got = jax.jit(functools.partial(score_update, params=params))(
+            fmd, slow, 0.9, 0.8)
+        # the carried counters must come back bit-for-bit; the weighted
+        # score read tolerates a few ulp of FMA contraction — the same
+        # class of difference XLA's own fusion choices introduce between
+        # jitted and eager evaluations of the reference formula
+        if not (bool(jnp.all(got[0] == want[0]))
+                and bool(jnp.all(got[1] == want[1]))):
+            return False
+        return bool(jnp.allclose(got[2], want[2], rtol=1e-5, atol=1e-6))
+    except Exception:  # noqa: BLE001 - ANY failure means "not available"
+        return False
+
+
+@functools.cache
+def score_kernel_available() -> bool:
+    """One-shot cached capability verdict. Env override DST_PALLAS_SCORE:
+    "0" forces off, "1" runs the probe but RAISES on failure (so a
+    toolchain where the kernel should work can't silently degrade)."""
+    env = os.environ.get(_ENV, "")
+    if env == "0":
+        return False
+    ok = _probe()
+    if env == "1" and not ok:
+        raise RuntimeError(
+            "DST_PALLAS_SCORE=1 but the scoring-update probe failed "
+            "(backend not TPU, Mosaic rejected the kernel, or numerics "
+            "mismatched)")
+    return ok

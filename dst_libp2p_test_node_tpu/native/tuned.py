@@ -1,0 +1,58 @@
+"""Autotuned kernel block-size table (ISSUE 16, arXiv:1912.03413 style).
+
+The microbench harness (runtime/microbench.py) sweeps each Pallas kernel's
+row-block candidates and writes the winners to a strict-JSON `tuned.json`:
+
+    {"vmem_gather": {"block_rows": 256}, "score_update": {"block_rows": 128}}
+
+The kernels' block choosers consult this table before falling back to the
+built-in largest-dividing-power-of-two heuristic. The table is OPTIONAL and
+advisory: a missing file, malformed entry, or a block that does not tile
+the requested row count exactly is ignored (the heuristic answer is always
+valid), so shipping no table — the default — changes nothing. The search
+path is `DST_TUNED_JSON` when set, else `tuned.json` next to this module
+(where `microbench --install` writes it).
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+
+_ENV = "DST_TUNED_JSON"
+
+
+def tuned_path() -> str:
+    return (os.environ.get(_ENV)
+            or os.path.join(os.path.dirname(__file__), "tuned.json"))
+
+
+@functools.cache
+def _load() -> dict:
+    try:
+        with open(tuned_path()) as fh:
+            table = json.load(fh)
+        return table if isinstance(table, dict) else {}
+    except (OSError, ValueError):
+        return {}
+
+
+def invalidate_cache() -> None:
+    """Drop the cached table (microbench re-reads after writing it)."""
+    _load.cache_clear()
+
+
+def tuned_block_rows(kernel: str, n_rows: int, max_block: int) -> int | None:
+    """The tuned row block for `kernel`, or None when the table has no
+    usable entry. Usable = a positive int that tiles n_rows exactly and
+    respects the kernel's VMEM ceiling — anything else falls back to the
+    caller's heuristic rather than producing an invalid grid."""
+    entry = _load().get(kernel)
+    if not isinstance(entry, dict):
+        return None
+    block = entry.get("block_rows")
+    if (isinstance(block, int) and not isinstance(block, bool)
+            and 0 < block <= max_block and n_rows % block == 0):
+        return block
+    return None

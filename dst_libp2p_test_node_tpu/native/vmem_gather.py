@@ -34,6 +34,8 @@ import os
 import jax
 import jax.numpy as jnp
 
+from .tuned import tuned_block_rows
+
 _ENV = "DST_PALLAS_GATHER"
 
 # largest row-block whose int32 index + f32 output tiles stay a small
@@ -43,10 +45,15 @@ _MAX_BLOCK = 512
 
 
 def _block_rows(n_rows: int) -> int:
-    """Largest power-of-two row block <= _MAX_BLOCK dividing n_rows (grid
-    steps must tile the array exactly; every simulator shape is a round
-    number, and a worst-case odd N just runs block=1 under interpret in
-    tests — the probe rejects it for the real kernel)."""
+    """The microbench autotuner's tuned.json block when it has a valid
+    entry (native/tuned.py), else the largest power-of-two row block
+    <= _MAX_BLOCK dividing n_rows (grid steps must tile the array exactly;
+    every simulator shape is a round number, and a worst-case odd N just
+    runs block=1 under interpret in tests — the probe rejects it for the
+    real kernel)."""
+    tuned = tuned_block_rows("vmem_gather", n_rows, _MAX_BLOCK)
+    if tuned is not None:
+        return tuned
     b = 1
     while b < _MAX_BLOCK and n_rows % (b * 2) == 0:
         b *= 2
@@ -54,13 +61,18 @@ def _block_rows(n_rows: int) -> int:
 
 
 @functools.cache
-def _compiled(n_rows: int, cap: int, n_src: int, interpret: bool):
+def _compiled(n_rows: int, cap: int, n_src: int, interpret: bool,
+              block_rows: int | None = None):
     """Build the pallas_call for one (rows, cap, src-len) shape. Raises
-    whatever Pallas/Mosaic raises — callers go through the probe."""
+    whatever Pallas/Mosaic raises — callers go through the probe.
+    `block_rows` overrides the tuned/heuristic block (the microbench
+    sweep's knob); it must tile n_rows exactly."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    block = _block_rows(n_rows)
+    block = block_rows if block_rows is not None else _block_rows(n_rows)
+    if n_rows % block != 0:
+        raise ValueError(f"block_rows {block} does not tile {n_rows} rows")
     if not interpret and block < 8:
         # sub-tile row blocks can't meet the (8, 128) f32 tiling floor
         raise ValueError(f"row count {n_rows} leaves block {block} < 8")
@@ -89,14 +101,16 @@ def _compiled(n_rows: int, cap: int, n_src: int, interpret: bool):
 
 
 def vmem_gather(t_all: jnp.ndarray, src: jnp.ndarray, *,
-                interpret: bool = False) -> jnp.ndarray:
+                interpret: bool = False,
+                block_rows: int | None = None) -> jnp.ndarray:
     """out[q, j] = t_all[max(src[q, j], 0)] via the VMEM-resident kernel.
     Same clip-negative-to-0 convention as the XLA fallback (pad slots are
     masked by the caller's validity flags, so row 0's value is dead
-    there)."""
+    there). `block_rows` is the microbench sweep's explicit row-block
+    override; production callers leave it None (tuned.json/heuristic)."""
     idx = jnp.clip(src, 0)
     return _compiled(src.shape[0], src.shape[1], t_all.shape[0],
-                     interpret)(t_all.astype(jnp.float32), idx)
+                     interpret, block_rows)(t_all.astype(jnp.float32), idx)
 
 
 def _probe() -> bool:

@@ -122,6 +122,16 @@ class SimParams:
     # per-edge quantization is inside the bounded mode's error bar but
     # breaks the exact mode's model-of-record bit guarantees.
     packed_state: bool = False
+    # Fused mega-round scan (ARCHITECTURE §18): run the whole
+    # heartbeat-burst + publish round chain as ONE lax.scan over rounds —
+    # one device dispatch per round instead of one per phase. OFF by
+    # default: run_fused_rounds (ops/disseminate.py) literally delegates to
+    # the phase-split run_heartbeats + disseminate chain (same jit cache
+    # entries, zero retraces, zero extra PRNG splits, bit-identical). ON,
+    # the fused body calls the SAME per-phase programs under one trace, so
+    # delivery outcomes stay bitwise equal; float delays carry an rtol
+    # because XLA may re-fuse arithmetic inside the scan body.
+    fused_rounds: bool = False
     exclude_first_sender: bool = True   # don't forward back to the delivering peer
     idontwant_threshold_bytes: int = 1000  # go-test-node/main.go:165 (v1.2)
     churn_down_per_hb: float = 0.0  # P(alive peer dies) per heartbeat

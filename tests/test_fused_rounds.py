@@ -1,0 +1,265 @@
+"""Fused mega-round scan contracts (ARCHITECTURE §18).
+
+`run_fused_rounds` runs R x [heartbeat burst -> publish] rounds. The pins:
+
+  - disabled path (params.fused_rounds=False, the default) LITERALLY
+    delegates to the phase-split chain: bit-identical to a hand-written
+    loop over the public per-phase entrypoints, zero retraces on a warm
+    call (same jit cache entries — the bench/simulator convention of only
+    passing non-default kwargs).
+  - fused path == phase-split on delivery outcomes BITWISE (received /
+    lost_tx / answer_interleaved / sends / copies_rx), rtol on the float
+    delay fields (XLA may re-fuse arithmetic inside the scan body), across
+    mesh-only, fragmented, and gossip-heavy (lossy message-mode) scenarios.
+  - composition: fused x (adaptive attacker + telemetry) and fused x fault
+    cohorts reproduce the phase-split references (ints exact, floats
+    rtol 1e-5) with the widened (state, ctrl) carry threading through.
+  - nested device grids: the fused program vmapped over stacked trials
+    computes the same numbers whether the batch is replicated or placed on
+    the 2x4 / 4x2 trial x peer meshes (state bit-identical, float
+    reductions rtol 1e-5) — the shard boundary moves placement, never
+    numerics (test_trial_sharding's contract, now over the fused scan).
+
+conftest.py forces 8 virtual CPU devices, so the nested grids are real
+multi-device placements here.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dst_libp2p_test_node_tpu.config.topology import Topology, TopoParams
+from dst_libp2p_test_node_tpu.ops.adversary import (
+    AdaptivePolicy, AdversaryParams, attacker_cohort,
+)
+from dst_libp2p_test_node_tpu.ops.disseminate import disseminate, run_fused_rounds
+from dst_libp2p_test_node_tpu.ops.faults import FaultParams, fault_masks
+from dst_libp2p_test_node_tpu.ops.graph import build_connection_graph
+from dst_libp2p_test_node_tpu.ops.heartbeat import run_heartbeats
+from dst_libp2p_test_node_tpu.ops.state import (
+    SimParams, graph_arrays, init_state, strip_repair,
+)
+from dst_libp2p_test_node_tpu.ops.telemetry import TelemetryParams
+from dst_libp2p_test_node_tpu.parallel.sharding import (
+    make_trial_mesh, nested_batch_shardings, peer_submesh_sharding, replicated,
+)
+from dst_libp2p_test_node_tpu.runtime.profiling import count_retraces
+
+PUBS = [3, 9, 17]
+HB_PER_ROUND = 2
+PAYLOAD = 15_000
+
+
+def _setup(n=32, connect_to=4, seed=0, warm_hb=6, **over):
+    g = build_connection_graph(n, connect_to, seed=seed)
+    params = SimParams(n=n, capacity=g.capacity, **over)
+    state = init_state(params, seed=seed)
+    a = graph_arrays(g)
+    t = Topology.build(TopoParams(network_size=n, anchor_stages=3,
+                                  min_bandwidth=50, max_bandwidth=150,
+                                  min_latency=40, max_latency=130))
+    topo = (jnp.asarray(t.stage_of_peer), jnp.asarray(t.latency_ms),
+            jnp.asarray(t.bw_up_mbit))
+    # warm heartbeats build a mesh first (the bench chain's convention)
+    state = run_heartbeats(state, a["conns"], a["rev"], a["out_mask"],
+                           params, warm_hb)
+    return params, state, a, topo
+
+
+def _scoring_over():
+    return dict(slow_weight=-10.0, slow_decay=0.9, graylist_threshold=-50.0,
+                gossip_threshold=-10.0, publish_threshold=-20.0)
+
+
+def _tree_close(a, b, rtol):
+    """Int/bool leaves exact, float leaves rtol — delivery outcomes and
+    counters must not move at all; only float arithmetic may reassociate."""
+    def cmp(x, y):
+        x, y = np.asarray(x), np.asarray(y)
+        assert x.dtype == y.dtype and x.shape == y.shape
+        if x.dtype.kind in "biu":
+            np.testing.assert_array_equal(x, y)
+        else:
+            np.testing.assert_allclose(x, y, rtol=rtol)
+    jax.tree_util.tree_map(cmp, a, b)
+
+
+# scenario -> extra run_fused_rounds kwargs; gossip_heavy runs message-mode
+# loss at 30% so IHAVE/IWANT recovery (the w-round gossip fold) is live
+def _scenarios(lat):
+    return {
+        "mesh": {},
+        "frag": dict(fragments=3),
+        "gossip_heavy": dict(
+            loss_mode="message",
+            loss_stage=jnp.full(lat.shape, 0.3, jnp.float32)),
+    }
+
+
+@pytest.mark.parametrize("scenario", ["mesh", "frag", "gossip_heavy"])
+def test_fused_matches_phase_split(scenario):
+    params, state, a, (stage, lat, bw) = _setup()
+    kw = _scenarios(lat)[scenario]
+    args = (state, a["conns"], a["rev"], stage, lat, bw, a["out_mask"], PUBS)
+    s_s, res_s, obs_s = run_fused_rounds(
+        *args, params, PAYLOAD, HB_PER_ROUND, **kw)
+    fused = dataclasses.replace(params, fused_rounds=True)
+    s_f, res_f, obs_f = run_fused_rounds(
+        *args, fused, PAYLOAD, HB_PER_ROUND, **kw)
+    assert res_f.delay_ms.shape == (len(PUBS), params.n)
+    # delivery outcomes bitwise; delays carry the documented rtol
+    for field in ("received", "lost_tx", "answer_interleaved", "sends",
+                  "copies_rx", "ihave_sent", "iwant_sent", "converged",
+                  "refine_passes"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(res_s, field)),
+            np.asarray(getattr(res_f, field)), err_msg=field)
+    _tree_close(res_s, res_f, rtol=1e-6)
+    _tree_close(s_s, s_f, rtol=1e-6)
+    assert obs_s == {} or obs_s is not None
+    assert jax.tree_util.tree_structure(obs_s) == \
+        jax.tree_util.tree_structure(obs_f)
+
+
+def test_disabled_path_delegates_bitwise_and_zero_retrace():
+    params, state, a, (stage, lat, bw) = _setup()
+    args = (state, a["conns"], a["rev"], stage, lat, bw, a["out_mask"], PUBS)
+    # the independent ground truth: a hand-written loop over the public
+    # per-phase entrypoints with the exact statics the chains use
+    s_ref = state
+    ref = []
+    for pub in PUBS:
+        s_ref = run_heartbeats(s_ref, a["conns"], a["rev"], a["out_mask"],
+                               params, HB_PER_ROUND)
+        r, s_ref = disseminate(
+            s_ref, a["conns"], a["rev"], stage, lat, bw, publisher=pub,
+            t0_ms=s_ref.t_ms, params=params, payload_bytes=PAYLOAD)
+        ref.append(r)
+    ref = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *ref)
+
+    # first wrapper call also compiles the tiny eager stacking programs;
+    # the SECOND call is the zero-retrace pin — every per-phase jit cache
+    # entry the manual loop warmed must be hit as-is
+    s1, r1, _ = run_fused_rounds(*args, params, PAYLOAD, HB_PER_ROUND)
+    with count_retraces() as c:
+        s2, r2, obs2 = run_fused_rounds(*args, params, PAYLOAD, HB_PER_ROUND)
+        jax.block_until_ready(s2.mesh_mask)
+    assert c.count == 0, f"disabled path retraced: {c.events}"
+    jax.tree_util.tree_map(np.testing.assert_array_equal, ref, r2)
+    jax.tree_util.tree_map(np.testing.assert_array_equal, s_ref, s2)
+    jax.tree_util.tree_map(np.testing.assert_array_equal, r1, r2)
+    assert obs2 == {}
+
+
+def test_fused_composes_adaptive_attacker_and_telemetry():
+    params, state, a, (stage, lat, bw) = _setup(**_scoring_over())
+    att = jnp.asarray(attacker_cohort(params.n, 0.25, seed=0))
+    adv = AdversaryParams(scenario="sybil_graft_flood",
+                          adaptive=AdaptivePolicy(enabled=True))
+    tel = TelemetryParams(record=True)
+    kw = dict(attacker=att, adv=adv, telemetry=tel)
+    args = (state, a["conns"], a["rev"], stage, lat, bw, a["out_mask"], PUBS)
+    (s_s, c_s), res_s, obs_s = run_fused_rounds(
+        *args, params, PAYLOAD, HB_PER_ROUND, **kw)
+    fused = dataclasses.replace(params, fused_rounds=True)
+    (s_f, c_f), res_f, obs_f = run_fused_rounds(
+        *args, fused, PAYLOAD, HB_PER_ROUND, **kw)
+    _tree_close(s_s, s_f, rtol=1e-5)
+    _tree_close(c_s, c_f, rtol=1e-5)
+    _tree_close(res_s, res_f, rtol=1e-5)
+    # controller + flight-recorder channels ride the fused ys with the
+    # (R, hb_per_round, ...) layout the phase-split stacking produces
+    assert set(obs_s) == set(obs_f)
+    for k in obs_s:
+        assert obs_f[k].shape[:2] == (len(PUBS), HB_PER_ROUND), k
+    _tree_close(obs_s, obs_f, rtol=1e-5)
+    assert any(k.startswith("adv_") for k in obs_f)
+    assert any(k.startswith("tel_") for k in obs_f)
+
+
+def test_fused_composes_fault_cohorts():
+    params, state, a, (stage, lat, bw) = _setup(**_scoring_over())
+    faults = FaultParams(crash_frac=0.1, crash_window=(1, 4),
+                         partition_frac=0.3, partition_window=(1, 3),
+                         spike_frac=0.2, spike_window=(0, 4), spike_ms=50.0)
+    masks = fault_masks(params.n, faults, seed=0, publisher=PUBS[0])
+    # zero-attacker cohort: faults compose on the attack window
+    att = jnp.asarray(attacker_cohort(params.n, 0.0, seed=0))
+    kw = dict(attacker=att, adv=AdversaryParams(), faults=faults,
+              crash=jnp.asarray(masks["crash"]),
+              side=jnp.asarray(masks["side"]),
+              spike=jnp.asarray(masks["spike"]))
+    args = (state, a["conns"], a["rev"], stage, lat, bw, a["out_mask"], PUBS)
+    s_s, res_s, obs_s = run_fused_rounds(
+        *args, params, PAYLOAD, HB_PER_ROUND, **kw)
+    fused = dataclasses.replace(params, fused_rounds=True)
+    s_f, res_f, obs_f = run_fused_rounds(
+        *args, fused, PAYLOAD, HB_PER_ROUND, **kw)
+    _tree_close(s_s, s_f, rtol=1e-5)
+    _tree_close(res_s, res_f, rtol=1e-5)
+    assert set(obs_s) == set(obs_f)
+    _tree_close(obs_s, obs_f, rtol=1e-5)
+    # both armed fault families report their observables each round
+    assert "cross_mesh_edges" in obs_f
+    assert "restarted_mean_degree" in obs_f
+
+
+@pytest.mark.parametrize("groups", [2, 4])
+def test_fused_nested_grids_match_replicated(groups):
+    # 2x4 and 4x2 trial x peer grids under conftest's 8 devices: the fused
+    # scan vmapped over a stacked trial batch must be placement-invariant
+    params, _, a, (stage, lat, bw) = _setup(**_scoring_over())
+    fused = dataclasses.replace(params, fused_rounds=True)
+    trials = 4
+    # strip_repair'd per-seed states stacked on a leading trial axis
+    # (test_trial_sharding._stacked_attack_fixture's recipe)
+    states = [strip_repair(init_state(params, seed=s))[0]
+              for s in range(trials)]
+    stacked = jax.tree_util.tree_map(
+        lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]), *states)
+
+    def go(s):
+        head, res, _obs = run_fused_rounds(
+            s, a["conns"], a["rev"], stage, lat, bw, a["out_mask"], PUBS,
+            fused, PAYLOAD, HB_PER_ROUND)
+        return head, res
+
+    out_rep = jax.vmap(go)(stacked)
+    mesh = make_trial_mesh(groups)
+    placed = jax.tree_util.tree_map(
+        jax.device_put, stacked,
+        nested_batch_shardings(stacked, mesh, params.n))
+    # shared epoch-graph/topology rows shard over each group's peer
+    # submesh; the tiny stage matrices replicate
+    prow, rep = peer_submesh_sharding(mesh), replicated(mesh)
+    a = {k: jax.device_put(v, prow) for k, v in a.items()}
+    stage = jax.device_put(stage, prow)
+    bw = jax.device_put(bw, prow)
+    lat = jax.device_put(lat, rep)
+    out_sh = jax.vmap(go)(placed)
+    st_r, res_r = out_rep
+    st_s, res_s = out_sh
+    # placement moves layout, never per-peer numerics: state comes back
+    # bit-identical; float reductions may reassociate across shards
+    jax.tree_util.tree_map(np.testing.assert_array_equal, st_r, st_s)
+    _tree_close(res_r, res_s, rtol=1e-5)
+
+
+def test_fused_arming_validation():
+    params, state, a, (stage, lat, bw) = _setup()
+    args = (state, a["conns"], a["rev"], stage, lat, bw, a["out_mask"], PUBS)
+    att = jnp.asarray(attacker_cohort(params.n, 0.1, seed=0))
+    with pytest.raises(ValueError, match="arm together"):
+        run_fused_rounds(*args, params, PAYLOAD, HB_PER_ROUND, attacker=att)
+    with pytest.raises(ValueError, match="attack window"):
+        run_fused_rounds(*args, params, PAYLOAD, HB_PER_ROUND,
+                         faults=FaultParams(crash_frac=0.1,
+                                            crash_window=(0, 2)))
+    from dst_libp2p_test_node_tpu.ops.state import init_adaptive_ctrl
+    with pytest.raises(ValueError, match="adaptive is disabled"):
+        run_fused_rounds(*args, params, PAYLOAD, HB_PER_ROUND,
+                         ctrl=init_adaptive_ctrl(params.n))
