@@ -201,6 +201,23 @@ def _adaptive_attack_spec() -> TraceSpec:
                     ctrl=init_adaptive_ctrl(params.n)))
 
 
+def _conform_spec() -> TraceSpec:
+    import jax.numpy as jnp
+
+    from ..ops.adversary import AdversaryParams, attacker_cohort
+    from .conformance import differential_round
+
+    # the conformance harness's own fixture arming: thresholds live, repair
+    # off — the program the differential walks per heartbeat
+    g, params, state, a, _ = _single_topic(**_ARMED)
+    att = jnp.asarray(attacker_cohort(params.n, 0.25, seed=1))
+    return TraceSpec(
+        fn=differential_round,
+        args=(state, a["conns"], a["rev"], a["out_mask"], att),
+        kwargs=dict(params=params, adv=AdversaryParams(),
+                    hb_idx=jnp.int32(0)))
+
+
 def _faults_spec() -> TraceSpec:
     import jax.numpy as jnp
 
@@ -789,4 +806,16 @@ def default_contracts() -> list[EntrypointContract]:
             expected_conds=2,
             feedback=[(_new_state_of, _state_arg_of)],
             notes="T*N block-diagonal stack keeps the single-topic conds"),
+        EntrypointContract(
+            name="conformance/differential_round",
+            build=_conform_spec,
+            expected_conds=4,
+            feedback=[(lambda out: out, _state_arg_of)],
+            notes="the compiled side of the spec-differential gate "
+                  "(analysis/conformance.py): one heartbeat_step -> "
+                  "adversary_round composition per round, audited here so "
+                  "the program the conformance oracle certifies is the "
+                  "same steady-state-skip program the runners scan (the 4 "
+                  "heartbeat conds must survive; the returned state feeds "
+                  "the next round aval-stable)"),
     ]

@@ -33,6 +33,12 @@ Subcommands:
   lint       — graft-audit static certification: AST lint over the python
                surface + jaxpr audit of every registered hot entrypoint
                (analysis/). Strict-JSON report on stdout, exit 0 iff clean.
+  conform    — conformance oracle (analysis/conformance.py): differential-
+               test the compiled heartbeat/adversary step against the
+               pure-numpy GossipSub v1.1 reference model (ops/spec.py,
+               ACL2s transcription) over the attack canon and emit a
+               strict-JSON certificate. Unwaivered divergence = exit 1
+               (waiver table: docs/CONFORMANCE.md).
   trace      — flight-recorder export (ops/telemetry.py): run a warmup plus
                a recorded heartbeat window and emit a Chrome-trace/perfetto
                JSON timeline, a per-round .npz and a CSV of every tel_*
@@ -1161,6 +1167,59 @@ def cmd_lint(argv: list[str]) -> int:
     return 1 if violations else 0
 
 
+def cmd_conform(argv: list[str]) -> int:
+    """Conformance oracle: spec-differential certification of the compiled
+    step against the pure-numpy GossipSub v1.1 reference model.
+
+    Emits the strict-JSON certificate (stdout or --out). Exit 0 iff every
+    divergence is absent or carries a documented_choice waiver
+    (docs/CONFORMANCE.md); any sim_bug is a hard failure.
+    """
+    p = argparse.ArgumentParser(prog="conform")
+    p.add_argument("--all-scenarios", action="store_true",
+                   help="run the full canon: all 8 attack scenarios plus "
+                        "the adaptive, faults, churn and cross-fragment "
+                        "entries (default when no --scenario is given)")
+    p.add_argument("--scenario", action="append", default=None,
+                   help="restrict to specific attack scenario(s); "
+                        "repeatable. Skips the adaptive/faults/churn/"
+                        "gossip entries unless --all-scenarios is also set")
+    p.add_argument("--n", type=int, default=48,
+                   help="peers per differential instance (default 48)")
+    p.add_argument("--connect-to", type=int, default=8)
+    p.add_argument("--steps", type=int, default=8,
+                   help="attack heartbeats walked per instance")
+    p.add_argument("--warm-steps", type=int, default=4)
+    p.add_argument("--seeds", type=int, nargs="+", default=[0],
+                   help="fuzz seeds; each reseeds graph, state and cohort")
+    p.add_argument("--out", default=None,
+                   help="certificate path (default: stdout)")
+    a = p.parse_args(argv)
+
+    from .analysis.conformance import (conformance_certificate,
+                                       write_certificate)
+    from .runtime.summarize import sanitize_nonfinite
+
+    full = a.all_scenarios or a.scenario is None
+    cert = conformance_certificate(
+        scenarios=a.scenario, n=a.n, connect_to=a.connect_to,
+        seeds=tuple(a.seeds), steps=a.steps, warm_steps=a.warm_steps,
+        include_adaptive=full, include_faults=full, include_churn=full,
+        include_gossip=full)
+    if a.out:
+        write_certificate(cert, a.out)
+    else:
+        print(json.dumps(sanitize_nonfinite(cert), indent=2,
+                         allow_nan=False))
+    for e in cert["entries"]:
+        line = f"conform: {e['scenario']:<22} {e['status']}"
+        if e["divergences"]:
+            line += f" ({len(e['divergences'])} divergence(s), " \
+                    f"{e['sim_bugs']} sim_bug(s))"
+        print(line, file=sys.stderr)
+    return 0 if cert["clean"] else 1
+
+
 def cmd_microbench(argv: list[str]) -> int:
     """Microbenchmark + autotune harness (runtime/microbench.py): roofline
     coordinates per registered entrypoint, the Pallas row-block sweep, and
@@ -1328,6 +1387,8 @@ def main(argv: list[str] | None = None) -> int:
         return cmd_regression(rest)
     if cmd == "lint":
         return cmd_lint(rest)
+    if cmd == "conform":
+        return cmd_conform(rest)
     if cmd == "trace":
         return cmd_trace(rest)
     if cmd == "microbench":

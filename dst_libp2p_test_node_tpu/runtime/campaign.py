@@ -281,6 +281,10 @@ class CampaignConfig:
     # splits the window into an attacked leg and a healed leg. The default
     # (all-off) leaves every trial on the exact pre-DHT program.
     dht: DhtAdversaryParams = field(default_factory=DhtAdversaryParams)
+    # attach a small-N conformance certificate for this campaign's scenario
+    # (analysis/conformance.py) to CampaignResult.conformance — the sweep's
+    # artifact then carries its own faithfulness check alongside the budget
+    conformance: bool = False
 
     def adversary_params(self) -> AdversaryParams:
         return self.adversary or AdversaryParams(scenario=self.scenario)
@@ -409,6 +413,9 @@ class CampaignResult:
     degraded: bool = False
     quarantined_trials: list = field(default_factory=list)
     retries_total: int = 0
+    # conformance certificate for this scenario (CampaignConfig.conformance;
+    # analysis/conformance.py) — None when the gate wasn't requested
+    conformance: dict | None = None
 
     @property
     def trials_per_s(self) -> float:
@@ -424,6 +431,7 @@ class CampaignResult:
             "degraded": self.degraded,
             "retries_total": self.retries_total,
             "quarantined_trials": list(self.quarantined_trials),
+            "conformance": self.conformance,
             "trials": [t.to_dict() for t in self.trials],
         })
 
@@ -1537,6 +1545,7 @@ def run_campaign(cfg: CampaignConfig, mesh=None,
                 trials.extend(res1)
             else:
                 _quarantine(f, [s], err1)
+    conformance = _campaign_conformance(cfg, adv) if cfg.conformance else None
     return CampaignResult(
         scenario=cfg.scenario,
         network_size=sim.params.n,
@@ -1546,7 +1555,36 @@ def run_campaign(cfg: CampaignConfig, mesh=None,
         degraded=bool(quarantined) or retries_total > 0,
         quarantined_trials=quarantined,
         retries_total=retries_total,
+        conformance=conformance,
     )
+
+
+def _campaign_conformance(cfg: CampaignConfig, adv: AdversaryParams) -> dict:
+    """Small-N conformance certificate for the campaign's scenario
+    (CampaignConfig.conformance): the scenario differential, plus the
+    adaptive-controller and fault-family differentials when the campaign
+    arms them. Cost is one N=48 instance per entry — noise next to any
+    sweep — and the result rides the summary artifact via to_dict()."""
+    from ..analysis.conformance import (certificate_entry, load_waivers,
+                                        run_adaptive_differential,
+                                        run_faults_differential,
+                                        run_scenario_differential)
+
+    waivers = load_waivers()
+    meta = dict(seeds=[0], n=48, steps=8)
+    entries = [certificate_entry(
+        cfg.scenario, run_scenario_differential(cfg.scenario), waivers,
+        **meta)]
+    if adv.adaptive.enabled:
+        entries.append(certificate_entry(
+            "adaptive", run_adaptive_differential(cfg.scenario), waivers,
+            **meta))
+    if cfg.faults.enabled:
+        entries.append(certificate_entry(
+            "faults", run_faults_differential(), waivers, **meta))
+    sim_bugs = sum(e["sim_bugs"] for e in entries)
+    return {"entries": entries, "sim_bugs": sim_bugs,
+            "clean": sim_bugs == 0}
 
 
 # ---------------------------------------------------- defense Pareto sweep

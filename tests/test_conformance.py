@@ -1,0 +1,171 @@
+"""Conformance oracle tests (ops/spec.py + analysis/conformance.py).
+
+Pins the gate's three properties: the compiled step conforms to the
+pure-numpy GossipSub v1.1 reference model on the attack canon (zero
+divergences), the differential actually discriminates (injected spec
+violations are caught and classified sim_bug), and the certificate
+artifact is strict JSON with the waiver machinery resolving the one
+documented modeling choice.
+"""
+
+import json
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from dst_libp2p_test_node_tpu.analysis.conformance import (
+    MUTANTS,
+    certificate_entry,
+    classify,
+    conformance_certificate,
+    cross_fragment_check,
+    load_waivers,
+    run_adaptive_differential,
+    run_churn_differential,
+    run_faults_differential,
+    run_scenario_differential,
+    write_certificate,
+)
+
+# the tier-1 sample of the canon: graft-flood (the mesh-pressure family),
+# spam (penalty + uplink accounting), rotation (the scrub + hb_idx path),
+# mimicry (the counter-pinning write). The full 8-scenario sweep runs in
+# the CI conformance smoke step and in test_adversary's budget test.
+_TIER1_SCENARIOS = ("sybil_graft_flood", "iwant_spam", "identity_rotation",
+                    "slow_peer_mimicry")
+
+
+@pytest.mark.parametrize("scenario", _TIER1_SCENARIOS)
+def test_scenario_differential_is_clean(scenario):
+    divs = run_scenario_differential(scenario, n=48, steps=8)
+    assert divs == [], divs[:3]
+
+
+def test_adaptive_differential_is_clean():
+    """Controller carry + PX poison (repair leaves live) conform too."""
+    divs = run_adaptive_differential(n=48, steps=8)
+    assert divs == [], divs[:3]
+
+
+def test_faults_differential_is_clean():
+    """Crash + partition + spike over a graft flood: the one-call scan
+    runner's final state equals the spec's per-round replay."""
+    divs = run_faults_differential(n=48, steps=8)
+    assert divs == [], divs[:3]
+
+
+def test_churn_differential_is_clean():
+    """Benign churn walk: the k_churn PRNG draws and liveness validity."""
+    divs = run_churn_differential(n=48, steps=8)
+    assert divs == [], divs[:3]
+
+
+@pytest.mark.parametrize("mutant", sorted(MUTANTS))
+def test_mutant_is_caught_as_sim_bug(mutant):
+    """The differential discriminates: a step that violates the spec (drops
+    the PRUNE backoff write / rolls back the behaviour penalty) must
+    diverge, and with no waiver row covering engine-state fields the
+    records classify as sim_bug — the hard-failure class."""
+    divs = run_scenario_differential("sybil_graft_flood", n=48, steps=8,
+                                     mutate=MUTANTS[mutant])
+    assert divs, f"mutant {mutant} produced no divergence"
+    classified = classify(divs, load_waivers())
+    assert all(d["classification"] == "sim_bug" for d in classified)
+    entry = certificate_entry("sybil_graft_flood", divs, load_waivers())
+    assert entry["status"] == "fail"
+
+
+def test_cross_fragment_shape_is_waived_documented_choice():
+    """VERDICT round-5 item 6: the `with_gossip AND fragments>1` shape.
+    Answer waits DO fire there (the uncoupled cross-fragment serialization
+    is load-bearing), and the docs/CONFORMANCE.md waiver table must resolve
+    the record as documented_choice — never silently green, never a
+    sim_bug."""
+    divs = cross_fragment_check()
+    assert divs, ("cross-fragment answer waits no longer fire — the "
+                  "uncoupling may have been closed; retire the waiver row "
+                  "in docs/CONFORMANCE.md and pin this green instead")
+    classified = classify(divs, load_waivers())
+    assert classified[0]["classification"] == "documented_choice"
+    assert classified[0]["waiver"] == "cross-fragment-answer-serialization"
+    entry = certificate_entry("gossip_fragments", divs, load_waivers())
+    assert entry["status"] == "waived"
+    assert entry["sim_bugs"] == 0
+
+
+def test_waiver_table_parses():
+    """The committed waiver table must parse and stay minimal: every row
+    fully keyed, the cross-fragment row present."""
+    waivers = load_waivers()
+    assert waivers, "docs/CONFORMANCE.md waiver table is empty or missing"
+    for w in waivers:
+        assert w["key"] and w["scenario"] and w["field"] and w["rationale"]
+    keys = [w["key"] for w in waivers]
+    assert "cross-fragment-answer-serialization" in keys
+    assert len(keys) == len(set(keys)), "duplicate waiver keys"
+
+
+def test_unknown_divergence_classifies_as_sim_bug():
+    fake = [{"scenario": "sybil_graft_flood", "seed": 0, "step": 1,
+             "field": "mesh_mask", "count": 3, "max_abs_err": 1.0,
+             "sim_sample": True, "spec_sample": False}]
+    out = classify(fake, load_waivers())
+    assert out[0]["classification"] == "sim_bug"
+    assert out[0]["waiver"] is None
+
+
+def test_certificate_is_strict_json(tmp_path):
+    """A one-scenario certificate round-trips through the strict writer:
+    no NaN/inf anywhere (allow_nan=False both ways), schema fields
+    present, clean verdict for a conformant scenario."""
+    cert = conformance_certificate(
+        scenarios=("sybil_graft_flood",), seeds=(0,), include_adaptive=False,
+        include_faults=False, include_churn=False, include_gossip=False)
+    path = write_certificate(cert, tmp_path / "conformance.json")
+    loaded = json.loads(path.read_text(),
+                        parse_constant=lambda c: pytest.fail(f"non-finite {c}"))
+    assert loaded["version"] == 1
+    assert loaded["clean"] is True
+    assert loaded["sim_bugs"] == 0
+    assert [e["scenario"] for e in loaded["entries"]] == ["sybil_graft_flood"]
+    assert loaded["entries"][0]["status"] == "pass"
+
+
+def test_conform_cli_single_scenario(tmp_path):
+    """`conform --scenario X` exits 0 and writes the certificate artifact
+    (the --all-scenarios sweep is the CI smoke step's job; one scenario
+    keeps the tier-1 subprocess under a compile budget)."""
+    out = tmp_path / "cert.json"
+    proc = subprocess.run(
+        [sys.executable, "-m", "dst_libp2p_test_node_tpu", "conform",
+         "--scenario", "sybil_graft_flood", "--steps", "6", "--out",
+         str(out)],
+        capture_output=True, text=True, timeout=600,
+        env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    cert = json.loads(out.read_text())
+    assert cert["clean"] is True
+
+
+def test_spec_score_matches_engine():
+    """Unit anchor under the differential: the spec's score law is the
+    engine's SimState.score on a random counter state."""
+    import jax.numpy as jnp
+
+    from dst_libp2p_test_node_tpu.ops.graph import build_connection_graph
+    from dst_libp2p_test_node_tpu.ops.spec import host_state, spec_score
+    from dst_libp2p_test_node_tpu.ops.state import SimParams, init_state
+
+    g = build_connection_graph(32, 4, seed=0)
+    params = SimParams(n=32, capacity=g.capacity, slow_weight=-10.0,
+                       graylist_threshold=-50.0)
+    state = init_state(params, seed=0)
+    rng = np.random.default_rng(7)
+    state = state.replace(
+        fmd=jnp.asarray(rng.uniform(0, 20, state.fmd.shape).astype(np.float32)),
+        slow_penalty=jnp.asarray(
+            rng.uniform(0, 8, state.slow_penalty.shape).astype(np.float32)))
+    np.testing.assert_array_equal(
+        spec_score(host_state(state), params), np.asarray(state.score(params)))

@@ -182,3 +182,29 @@ def test_parity_test_file_count_matches_tree():
     assert int(m[1]) == actual, (
         f"PARITY.md claims {m[1]} test files; tests/ has {actual}"
     )
+
+
+def test_readme_delivery_mode_labels_match_bench_configs():
+    # Which delivery mode each ladder row ran is part of the row's meaning
+    # (bounded numbers carry an error bar, exact ones do not), and the
+    # README's prose labels drifted from the artifact once already: the
+    # committed config-4 row stayed bounded for two rounds after exact
+    # became its default. bench_configs.py now records delivery_mode in
+    # every gossip-bearing row; the README must label each such config
+    # with the canonical phrase 'config N runs the <mode> delivery mode'
+    # and the label must match the artifact.
+    rows = _artifact()
+    tagged = {c: r["delivery_mode"] for c, r in rows.items()
+              if "delivery_mode" in r}
+    assert tagged, "no BENCH_CONFIGS.json row records delivery_mode"
+    readme = _read("README.md")
+    labeled = {int(c): mode for c, mode in re.findall(
+        r"[Cc]onfig\s+(\d)\s+runs\s+the\s+(exact|bounded)\s+delivery\s+mode",
+        readme)}
+    for c, mode in sorted(tagged.items()):
+        assert c in labeled, (
+            f"README must label config {c} with the canonical phrase "
+            f"'config {c} runs the <mode> delivery mode'")
+        assert labeled[c] == mode, (
+            f"README labels config {c} as {labeled[c]}; committed "
+            f"BENCH_CONFIGS.json row says {mode} — update the doc")
