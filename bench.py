@@ -1001,6 +1001,27 @@ def main() -> None:
         from dst_libp2p_test_node_tpu.runtime.profiling import roofline
 
         out["detail"]["roofline"] = roofline()
+    # sharding block (analysis/sharding_audit.py): GSPMD facts — collective
+    # kinds/volumes, per-device peak, replicated operands — for the window
+    # contracts the campaign configs dispatch, so a bench artifact records
+    # the partitioning it ran under next to the throughput it measured.
+    # Env-gated like the roofline (one XLA compile per audited contract);
+    # BENCH_SHARDING_ONLY narrows the contract-name prefix (default the
+    # campaign/ window family)
+    if _os.environ.get("BENCH_SHARDING", "") == "1":
+        from dst_libp2p_test_node_tpu.analysis.registry import (
+            default_contracts)
+        from dst_libp2p_test_node_tpu.analysis.sharding_audit import (
+            audit_sharding_contracts)
+
+        prefix = _os.environ.get("BENCH_SHARDING_ONLY", "campaign/")
+        sh_v, sh_w, sh_facts = audit_sharding_contracts(
+            [c for c in default_contracts() if c.name.startswith(prefix)])
+        out["detail"]["sharding"] = {
+            "facts": sh_facts,
+            "violations": [v.to_dict() for v in sh_v],
+            "waived": sh_w,
+        }
     # flight-recorder overhead probe: the disabled recorder delegates to
     # the SAME jitted run_heartbeats (ops/telemetry.py), so this measures
     # the recorder-off dispatch overhead on the real bench state — the

@@ -1,25 +1,37 @@
 """graft-audit: static analysis + contracts for the jitted hot paths.
 
-Two engines over one violation model (analysis/report.py):
+Three engines over one violation model (analysis/report.py):
 
   - jaxpr auditor (analysis/jaxpr_audit.py): abstractly traces every
     registered entrypoint (analysis/registry.py) and enforces loop/carry/
     cond/donation/compile-key contracts — GA-J*.
   - AST lint (analysis/ast_lint.py): source-level rules over the package's
     jitted scopes and artifact writers — GA-A*.
+  - sharding auditor (analysis/sharding_audit.py): compiles every
+    registered entrypoint and walks the GSPMD output for collective
+    volumes, operand replication, per-device memory and donation aliasing
+    — GA-S* — plus the 1M-rung footprint predictor.
 
 CLI: ``python -m dst_libp2p_test_node_tpu lint`` (strict-JSON report,
-nonzero exit on findings). Tier-1 gate: tests/test_graft_audit.py asserts
-the repo audits clean.
+nonzero exit on findings; ``--sharding`` / ``--predict-rung`` arm engine
+3, ``--format github`` adds inline PR annotations). Tier-1 gate:
+tests/test_graft_audit.py + tests/test_sharding_audit.py assert the repo
+audits clean. The full rule catalog lives in docs/LINT_RULES.md.
 """
 
 from .ast_lint import lint_paths, lint_source
 from .contracts import EntrypointContract, LadderRung, TraceSpec
 from .jaxpr_audit import audit_contract, audit_contracts, run_checkify
-from .report import RULES, Violation, render_report
+from .report import RULES, Violation, github_annotations, render_report
+from .sharding_audit import (audit_sharding_contract,
+                             audit_sharding_contracts,
+                             contract_sharding_facts,
+                             predict_rung_certificate)
 
 __all__ = [
     "EntrypointContract", "LadderRung", "TraceSpec", "Violation", "RULES",
     "audit_contract", "audit_contracts", "run_checkify",
-    "lint_paths", "lint_source", "render_report",
+    "audit_sharding_contract", "audit_sharding_contracts",
+    "contract_sharding_facts", "predict_rung_certificate",
+    "lint_paths", "lint_source", "render_report", "github_annotations",
 ]

@@ -80,4 +80,29 @@ class EntrypointContract:
     # any miss is weak-type/shape drift at the call boundary (the PR 1/PR 3
     # carry bugs) and fails tier-1 (tests/test_profiling.py).
     retrace_budget: int = 0
+    # --- sharding auditor (analysis/sharding_audit.py, GA-S family) ---
+    # collectives: the declared collective-op budget SET — every collective
+    # kind GSPMD may insert into this contract's compiled program
+    # (all-gather / all-reduce / reduce-scatter / collective-permute /
+    # all-to-all). Like retrace_budget, it is a ratchet: a kind that shows
+    # up in the compiled HLO without being declared here is GA-S002 (an
+    # unbudgeted cross-device data movement snuck into the hot window).
+    # None (the default) opts the contract out — right for single-device
+    # entrypoints; every contract traced on a multi-device mesh should
+    # declare one, even if empty (frozenset() = "no collectives allowed").
+    collectives: frozenset | None = None
+    # per-compile ceiling on the summed per-device byte volume of all
+    # collective outputs at the contract's canonical audit shape (GA-S003);
+    # None = unbudgeted
+    collective_bytes_budget: int | None = None
+    # per-device peak-memory ceiling (argument + output + temp − aliased,
+    # XLA memory_analysis) at the canonical audit shape (GA-S004);
+    # None = unbudgeted
+    hbm_budget_bytes: int | None = None
+    # pinned waivers: ((rule_id, rationale), ...). A finding whose rule is
+    # waived here is recorded in the report's "waived" block with its
+    # rationale instead of failing the gate — the docs/LINT_RULES.md waiver
+    # table mirrors these. A waiver names a deliberate modeling choice, not
+    # an escape hatch (same discipline as docs/CONFORMANCE.md).
+    waivers: tuple = ()
     notes: str = ""

@@ -64,6 +64,10 @@ The registered surface mirrors the BENCH hot paths exactly:
                           in/out_shardings over the full trials x peers
                           grid (2 groups x remaining devices per group),
                           peer rows partitioned inside each trial group
+  campaign/faulted_window_nested
+                          the fault-armed nested window: per-trial
+                          crash/side/spike cohorts shard over both grid
+                          axes like the attacker masks
   campaign/dht_attack_window
                           the cross-protocol recovery window
                           (ops/dht_adversary.py): repair armed, per-trial
@@ -249,14 +253,14 @@ def _sharded_attack_spec() -> TraceSpec:
 
     from ..ops.adversary import AdversaryParams, attacker_cohort
     from ..ops.state import strip_repair
-    from ..parallel.sharding import make_trial_mesh
+    from ..parallel.sharding import audit_trial_groups, make_trial_mesh
     from ..runtime.campaign import sharded_attack_window
 
     g, params, state, a, _ = _single_topic()
     # production path: params are repair-inert, so the campaign strips the
     # repair leaves host-side before stacking — trace the same program
     state, _saved = strip_repair(state)
-    groups = 2 if len(jax.devices()) >= 2 else 1
+    groups = audit_trial_groups()
     mesh = make_trial_mesh(groups, n_devices=groups)
     local = 2
     trials = groups * local
@@ -279,17 +283,18 @@ def _nested_attack_spec() -> TraceSpec:
 
     from ..ops.adversary import AdversaryParams, attacker_cohort
     from ..ops.state import strip_repair
-    from ..parallel.sharding import make_trial_mesh
+    from ..parallel.sharding import audit_trial_groups, make_trial_mesh
     from ..runtime.campaign import sharded_attack_window
 
     g, params, state, a, _ = _single_topic()
     state, _saved = strip_repair(state)
-    # the FULL grid: 2 trial groups x every remaining device as each
-    # group's peer submesh (2x2 under the CI lint gate's 4 virtual
-    # devices), degenerating gracefully to 1x1 on a single device — the
-    # contract always traces the nested pjit program the campaign
-    # dispatches, whatever the host's device count
-    groups = 2 if len(jax.devices()) >= 2 else 1
+    # the FULL grid: trial groups x every remaining device as each group's
+    # peer submesh (2x2 under the CI lint gate's 4 virtual devices),
+    # degenerating gracefully to 1x1 on a single device — the contract
+    # always traces the nested pjit program the campaign dispatches,
+    # whatever the host's device count. GRAFT_AUDIT_TRIAL_GROUPS flips the
+    # grid aspect (2x4 vs 4x2 under CI's 8 devices) without a code change.
+    groups = audit_trial_groups()
     mesh = make_trial_mesh(groups)
     local = 2
     trials = groups * local
@@ -313,14 +318,14 @@ def _dht_attack_window_spec() -> TraceSpec:
     from ..ops.adversary import attacker_cohort
     from ..ops.dht_adversary import (DhtAdversaryParams, build_attacked_dht,
                                      dht_repair_pool)
-    from ..parallel.sharding import make_trial_mesh
+    from ..parallel.sharding import audit_trial_groups, make_trial_mesh
     from ..runtime.campaign import sharded_dht_recovery_window
 
     # repair ARMED (no strip_repair): the DHT window exists to feed the
     # redial path a poisoned shortlist, so the audited program is the one
     # with the repair leaves live in the carry
     g, params, state, a, (stage, lat, bw) = _single_topic(**_REPAIR)
-    groups = 2 if len(jax.devices()) >= 2 else 1
+    groups = audit_trial_groups()
     mesh = make_trial_mesh(groups)
     local = 2
     trials = groups * local
@@ -345,6 +350,86 @@ def _dht_attack_window_spec() -> TraceSpec:
         args=(stacked, shared, None, jnp.stack(atts), jnp.stack(pools)),
         kwargs=dict(rparams=params, steps=3, publisher=3, trial_mesh=mesh,
                     local_trials=local))
+
+
+def _faulted_nested_spec() -> TraceSpec:
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.adversary import AdversaryParams, attacker_cohort
+    from ..ops.faults import FaultParams, fault_masks
+    from ..ops.state import strip_repair
+    from ..parallel.sharding import audit_trial_groups, make_trial_mesh
+    from ..runtime.campaign import sharded_faulted_window
+
+    g, params, state, a, _ = _single_topic(**_ARMED)
+    # production path: _ARMED leaves repair inert, so the campaign strips
+    # the repair leaves host-side before stacking (runtime/campaign.py's
+    # faulted dispatch) — trace that same program
+    state, _saved = strip_repair(state)
+    groups = audit_trial_groups()
+    mesh = make_trial_mesh(groups)
+    local = 2
+    trials = groups * local
+    stacked = jax.tree_util.tree_map(
+        lambda x: jnp.stack([jnp.asarray(x)] * trials), state)
+    faults = FaultParams(
+        crash_frac=0.2, crash_window=(0, 2),
+        partition_frac=0.3, partition_window=(1, 3),
+        spike_frac=0.2, spike_window=(0, 4), spike_ms=250.0)
+    atts, crs, sds, sps = [], [], [], []
+    for s in range(trials):
+        atts.append(jnp.asarray(attacker_cohort(params.n, 0.25, seed=s)))
+        fm = fault_masks(params.n, faults, seed=s, publisher=3)
+        crs.append(jnp.asarray(fm["crash"]))
+        sds.append(jnp.asarray(fm["side"]))
+        sps.append(jnp.asarray(fm["spike"]))
+    shared = {k: a[k] for k in ("conns", "rev", "out_mask")}
+    return TraceSpec(
+        fn=sharded_faulted_window,
+        args=(stacked, shared, jnp.stack(atts), jnp.stack(crs),
+              jnp.stack(sds), jnp.stack(sps)),
+        kwargs=dict(params=params, adv=AdversaryParams(), faults=faults,
+                    steps=3, trial_mesh=mesh, local_trials=local))
+
+
+def attack_rung_spec(n: int, *, steps: int = 20, connect_to: int = 10,
+                     local_trials: int = 2,
+                     trial_groups: int | None = None) -> TraceSpec:
+    """The 1M-rung ladder program at an arbitrary peer count: the nested
+    attack window exactly as bench_configs config 8 dispatches it
+    (scenario sybil_graft_flood, connect_to=10, fractions (0, 0.1) x seeds
+    (0, 1) -> 2 trial groups x 2 local trials). The sharding auditor's
+    rung predictor lowers THIS spec at 3-4 peer counts and extrapolates
+    the per-leaf footprints to ATTACK_RUNG_PEERS on a modeled v5e-8."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.adversary import AdversaryParams, attacker_cohort
+    from ..ops.state import strip_repair
+    from ..parallel.sharding import make_trial_mesh
+    from ..runtime.campaign import sharded_attack_window
+
+    g, params, state, a, _ = _single_topic(n=n, connect_to=connect_to)
+    state, _saved = strip_repair(state)
+    groups = 2 if trial_groups is None else trial_groups
+    mesh = make_trial_mesh(groups)
+    trials = groups * local_trials
+    stacked = jax.tree_util.tree_map(
+        lambda x: jnp.stack([jnp.asarray(x)] * trials), state)
+    # config 8's attacked fraction (the 0.0 baseline trials share the same
+    # program — the mask content never changes the compiled footprint)
+    att = jnp.stack([
+        jnp.asarray(attacker_cohort(params.n, 0.1, seed=s))
+        for s in range(trials)])
+    shared = {k: a[k] for k in ("conns", "rev", "out_mask")}
+    return TraceSpec(
+        fn=sharded_attack_window,
+        args=(stacked, shared, att),
+        kwargs=dict(params=params,
+                    adv=AdversaryParams(scenario="sybil_graft_flood"),
+                    steps=steps, trial_mesh=mesh,
+                    local_trials=local_trials))
 
 
 def _telemetry_spec() -> TraceSpec:
@@ -667,6 +752,10 @@ def default_contracts() -> list[EntrypointContract]:
             feedback=[(lambda out: out[0][0], _state_arg_of),
                       (lambda out: out[0][1],
                        lambda spec: spec.kwargs["ctrl"])],
+            # single-device program: any collective appearing in its
+            # compiled HLO means a mesh leaked into the unbatched window
+            collectives=frozenset(),
+            hbm_budget_bytes=2 * 1024 * 1024,
             notes="the adaptive attacker controller in the scan (ISSUE 15): "
                   "repair leaves live so PX poison writes real px_pool "
                   "rows; disabled configs are intentionally NOT registered "
@@ -696,12 +785,38 @@ def default_contracts() -> list[EntrypointContract]:
             build=_faults_spec,
             expected_conds=None,
             feedback=[(_first_out, _state_arg_of)],
+            # the UNBATCHED single-device window: collective-free by
+            # construction
+            collectives=frozenset(),
+            hbm_budget_bytes=2 * 1024 * 1024,
             notes="fault window with crash + partition + spike all armed "
                   "over an attacked mesh: the go-dark/restart and "
                   "freeze/thaw branches are window-scheduled lax.conds "
                   "inside the scan; state must feed back aval-stable so "
                   "retried trials resume from a checkpoint without a "
                   "recompile"),
+        EntrypointContract(
+            name="campaign/faulted_window_nested",
+            build=_faulted_nested_spec,
+            expected_conds=None,
+            feedback=[(_first_out, _state_arg_of)],
+            # explicit in/out_shardings force a fresh jit closure per
+            # window: one compile per call by construction
+            retrace_budget=1,
+            # ~18 KiB/device measured at the audit shape: the fault masks
+            # ride the same gathers as the attacker masks
+            collectives=frozenset(
+                {"all-gather", "all-reduce", "collective-permute"}),
+            collective_bytes_budget=72 * 1024,
+            hbm_budget_bytes=2 * 1024 * 1024,
+            notes="the fault-armed nested window (sharded_faulted_window): "
+                  "per-trial crash/side/spike cohorts shard over both grid "
+                  "axes exactly like the attacker masks, so fault sweeps "
+                  "ride the trials x peers grid instead of falling back to "
+                  "the vmapped single-device stack; repair leaves stripped "
+                  "(the _ARMED params are repair-inert, matching the "
+                  "campaign's host-side strip), and the sharding auditor "
+                  "pins the same collective-kind set as the attack window"),
         EntrypointContract(
             name="campaign/attack_window_sharded",
             build=_sharded_attack_spec,
@@ -710,6 +825,20 @@ def default_contracts() -> list[EntrypointContract]:
             # the wrapper jits a fresh shard_map closure per call — one
             # compile per window by construction, never more
             retrace_budget=1,
+            # trials are independent on the trial-only grid: no cross-
+            # device traffic is ever legitimate in this program
+            collectives=frozenset(),
+            hbm_budget_bytes=2 * 1024 * 1024,
+            # GA-S001 fires by design here: the legacy layout REPLICATES
+            # the epoch graph across the trial groups (that is what makes
+            # it the replicated-peer-submesh baseline the nested program
+            # is measured against) — pinned, not fixed
+            waivers=(("GA-S001",
+                      "legacy nested=False layout replicates the shared "
+                      "epoch graph (conns/rev) across trial groups by "
+                      "design — it exists as the replicated-peer-submesh "
+                      "equality baseline for the nested program "
+                      "(docs/ARCHITECTURE.md §13)"),),
             notes="legacy trial-only shard_map (nested=False), repair "
                   "leaves stripped — the replicated-peer-submesh baseline "
                   "the nested program is pinned against; the stacked state "
@@ -723,11 +852,23 @@ def default_contracts() -> list[EntrypointContract]:
             # explicit in/out_shardings force a fresh jit closure per
             # window: one compile per call by construction
             retrace_budget=1,
+            # measured at the canonical audit shape (N=32, 8 devices):
+            # ~16 KiB/device of collective output across the three kinds
+            # the neighbor gathers + trial reductions legitimately insert;
+            # budgets are ~4x ratchets, not estimates
+            collectives=frozenset(
+                {"all-gather", "all-reduce", "collective-permute"}),
+            collective_bytes_budget=64 * 1024,
+            hbm_budget_bytes=2 * 1024 * 1024,
             notes="the nested two-level pjit program the sharded sweep "
                   "actually dispatches: trials split over groups, peer "
                   "rows split over each group's submesh via explicit "
                   "in/out_shardings; same aval-stability and loop/carry "
-                  "bars as the legacy baseline"),
+                  "bars as the legacy baseline; the sharding auditor "
+                  "additionally pins its collective kinds and byte/HBM "
+                  "budgets (GA-S002..4) — a reduce-scatter or all-to-all "
+                  "appearing here means the partitioner stopped seeing "
+                  "the layout the grid was designed around"),
         EntrypointContract(
             name="campaign/dht_attack_window",
             build=_dht_attack_window_spec,
@@ -743,6 +884,13 @@ def default_contracts() -> list[EntrypointContract]:
             # heal leg traces its OWN closure over stacked graphs — a
             # separate entrypoint, not a retrace of this one)
             retrace_budget=1,
+            # ~23 KiB/device measured at the audit shape: the redial path
+            # gathers the poisoned (T, N, K) shortlists on top of the
+            # attack window's own collectives
+            collectives=frozenset(
+                {"all-gather", "all-reduce", "collective-permute"}),
+            collective_bytes_budget=96 * 1024,
+            hbm_budget_bytes=2 * 1024 * 1024,
             notes="the cross-protocol recovery window: repair leaves LIVE "
                   "(the poisoned shortlist feeds the redial path), the "
                   "(T, N, K) discovery pools shard over both grid axes and "

@@ -1,12 +1,13 @@
 """Violation model + strict-JSON report for graft-audit.
 
-Every finding — from the AST linter or the jaxpr auditor — is a Violation
-with a stable rule id, a repo-relative file:line anchor, and (for jaxpr
-rules) the registered entrypoint it was traced under. The report is strict
-JSON (`allow_nan=False`, sorted keys, deterministic violation order) so CI
-and the bench artifact pipeline can diff it byte-for-byte.
+Every finding — from the AST linter, the jaxpr auditor or the sharding
+auditor — is a Violation with a stable rule id, a repo-relative file:line
+anchor, and (for traced rules) the registered entrypoint it was traced
+under. The report is strict JSON (`allow_nan=False`, sorted keys,
+deterministic violation order) so CI and the bench artifact pipeline can
+diff it byte-for-byte.
 
-Rule catalog (see docs/ARCHITECTURE.md §10 for the long-form version):
+Rule catalog (see docs/LINT_RULES.md for the long-form version):
 
   GA-J001  host/io/debug callback inside a scan/while_loop body
   GA-J002  x64 dtype or weak-type promotion drift in a loop carry
@@ -18,6 +19,14 @@ Rule catalog (see docs/ARCHITECTURE.md §10 for the long-form version):
   GA-A003  Python `if`/`while`/ternary branching on a traced value
   GA-A004  device_get/block_until_ready/.item() host sync in a jitted scope
   GA-A005  json.dump without allow_nan=False or sanitize_nonfinite()
+  GA-S001  large operand replicated inside a sharded (multi-partition)
+           contract
+  GA-S002  collective kind in the compiled HLO not in the contract's
+           declared `collectives` budget set
+  GA-S003  summed per-device collective byte volume over the declared
+           budget
+  GA-S004  per-device peak memory over the declared HBM budget
+  GA-S005  donation declared but not aliased in the COMPILED output
 """
 
 from __future__ import annotations
@@ -38,6 +47,11 @@ RULES = {
     "GA-A003": "python-branch-on-tracer",
     "GA-A004": "host-sync-in-traced-scope",
     "GA-A005": "nonfinite-reachable-json",
+    "GA-S001": "replicated-large-operand",
+    "GA-S002": "undeclared-collective",
+    "GA-S003": "collective-bytes-over-budget",
+    "GA-S004": "peak-memory-over-budget",
+    "GA-S005": "donation-not-aliased-compiled",
 }
 
 
@@ -56,8 +70,16 @@ class Violation:
 
 
 def render_report(violations: list[Violation], *, checked_files: int = 0,
-                  checked_entrypoints: int = 0) -> str:
-    """Strict-JSON audit report; deterministic ordering, refuses NaN/Inf."""
+                  checked_entrypoints: int = 0,
+                  sharding: dict | None = None,
+                  waived: list[dict] | None = None,
+                  rung: dict | None = None) -> str:
+    """Strict-JSON audit report; deterministic ordering, refuses NaN/Inf.
+
+    Optional blocks (present only when the corresponding engine ran):
+    `sharding` — per-contract GSPMD facts from the sharding auditor;
+    `waived` — findings suppressed by a pinned contract waiver, each with
+    its rationale; `rung` — the 1M-rung feasibility certificate."""
     vs = sorted(violations, key=lambda v: (v.file, v.line, v.rule, v.message))
     counts: dict[str, int] = {}
     for v in vs:
@@ -71,7 +93,43 @@ def render_report(violations: list[Violation], *, checked_files: int = 0,
         "counts": counts,
         "violations": [v.to_dict() for v in vs],
     }
+    if sharding is not None:
+        out["sharding"] = sharding
+    if waived is not None:
+        out["waived"] = sorted(
+            waived, key=lambda w: (w.get("entrypoint") or "", w.get("rule")
+                                   or "", w.get("message") or ""))
+    if rung is not None:
+        out["rung_certificate"] = rung
     return json.dumps(out, indent=2, sort_keys=True, allow_nan=False)
+
+
+def _gh_escape(text: str) -> str:
+    """GitHub Actions workflow-command payload escaping."""
+    return (text.replace("%", "%25").replace("\r", "%0D").replace("\n", "%0A"))
+
+
+def github_annotations(violations: list[Violation],
+                       waived: list[dict] | None = None) -> list[str]:
+    """`::error`/`::notice` workflow-command lines (`lint --format github`):
+    one per finding, anchored at the violation's file:line so GA-* findings
+    render inline on PRs. Waived findings come through as notices — visible
+    on the diff, not failing the gate."""
+    lines = []
+    for v in sorted(violations,
+                    key=lambda v: (v.file, v.line, v.rule, v.message)):
+        who = f" [{v.entrypoint}]" if v.entrypoint else ""
+        lines.append(
+            f"::error file={_gh_escape(v.file)},line={max(v.line, 1)},"
+            f"title={v.rule} {RULES.get(v.rule, 'unknown')}::"
+            f"{_gh_escape(v.message + who)}")
+    for w in waived or []:
+        lines.append(
+            f"::notice file={_gh_escape(w.get('file') or 'unknown')},"
+            f"line={max(int(w.get('line') or 1), 1)},"
+            f"title={w.get('rule')} waived::"
+            f"{_gh_escape((w.get('message') or '') + ' — waiver: ' + (w.get('rationale') or ''))}")
+    return lines
 
 
 def suppressed_lines(source: str) -> set[int]:

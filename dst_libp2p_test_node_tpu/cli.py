@@ -1132,13 +1132,41 @@ def cmd_lint(argv: list[str]) -> int:
                    help="also run the opt-in runtime half of the contracts "
                         "(executes small configs under jax.experimental."
                         "checkify; slower)")
+    p.add_argument("--sharding", action="store_true",
+                   help="also run the sharding auditor (GA-S rules): "
+                        "compile every registered contract and walk the "
+                        "GSPMD output for collectives / replication / "
+                        "per-device memory (slower — real XLA compiles)")
+    p.add_argument("--only", default=None, metavar="PREFIX",
+                   help="restrict the jaxpr + sharding engines to "
+                        "contracts whose name starts with PREFIX (e.g. "
+                        "campaign/)")
+    p.add_argument("--predict-rung", nargs="?", const=1048576, type=int,
+                   default=None, metavar="PEERS",
+                   help="also fit the attack-window footprint curves and "
+                        "emit the rung feasibility certificate for PEERS "
+                        "(default 1048576) on a modeled v5e-8")
+    p.add_argument("--rung-out", default=None, metavar="PATH",
+                   help="also write the rung certificate alone to PATH "
+                        "(strict JSON; the report embeds it either way)")
+    p.add_argument("--out", default=None, metavar="PATH",
+                   help="write the strict-JSON report to PATH instead of "
+                        "stdout (github annotations still print to stdout)")
+    p.add_argument("--format", choices=("json", "github"), default="json",
+                   help="'github' additionally emits ::error/::notice "
+                        "workflow-command lines so GA-* findings render "
+                        "inline on PRs")
     a = p.parse_args(argv)
 
     from .analysis import audit_contracts, lint_paths, render_report, run_checkify
     from .analysis.registry import default_contracts
+    from .analysis.report import github_annotations
 
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     violations = []
+    waived: list[dict] = []
+    sharding_facts = None
+    rung_cert = None
     checked_files = 0
     checked_entrypoints = 0
 
@@ -1155,15 +1183,46 @@ def cmd_lint(argv: list[str]) -> int:
         ast_violations, checked_files = lint_paths(targets, repo_root)
         violations.extend(ast_violations)
 
+    contracts = default_contracts()
+    if a.only:
+        contracts = [c for c in contracts if c.name.startswith(a.only)]
     if not a.no_jaxpr:
-        contracts = default_contracts()
         checked_entrypoints = len(contracts)
         violations.extend(audit_contracts(contracts))
         if a.checkify:
             violations.extend(run_checkify(contracts))
 
-    print(render_report(violations, checked_files=checked_files,
-                        checked_entrypoints=checked_entrypoints))
+    if a.sharding:
+        from .analysis.sharding_audit import audit_sharding_contracts
+
+        checked_entrypoints = max(checked_entrypoints, len(contracts))
+        sh_violations, waived, sharding_facts = audit_sharding_contracts(
+            contracts)
+        violations.extend(sh_violations)
+
+    if a.predict_rung is not None:
+        from .analysis.sharding_audit import predict_rung_certificate
+
+        rung_cert = predict_rung_certificate(rung_peers=a.predict_rung)
+        if a.rung_out:
+            with open(a.rung_out, "w") as fh:
+                json.dump(rung_cert, fh, indent=2, sort_keys=True,
+                          allow_nan=False)
+                fh.write("\n")
+
+    if a.format == "github":
+        for line in github_annotations(violations, waived):
+            print(line)
+    report = render_report(
+        violations, checked_files=checked_files,
+        checked_entrypoints=checked_entrypoints,
+        sharding=sharding_facts, waived=waived if a.sharding else None,
+        rung=rung_cert)
+    if a.out:
+        with open(a.out, "w") as fh:
+            fh.write(report + "\n")
+    else:
+        print(report)
     return 1 if violations else 0
 
 

@@ -77,6 +77,29 @@ def peer_sharding(mesh: Mesh) -> NamedSharding:
 TRIAL_AXIS = "trials"
 
 
+def audit_trial_groups(n_devices: int | None = None) -> int:
+    """Trial-group count the audit/registry mesh builders use.
+
+    GRAFT_AUDIT_TRIAL_GROUPS overrides it so CI can trace every registered
+    window contract on BOTH full-grid aspect ratios (2x4 and 4x2 under 8
+    virtual devices) without touching the registry; the default is the
+    2-group grid (2 x remaining-devices-per-group), degenerating to 1 on a
+    single device. Must divide the device count evenly — same constraint
+    make_trial_mesh enforces."""
+    import os
+
+    nd = len(jax.devices()) if n_devices is None else n_devices
+    env = os.environ.get("GRAFT_AUDIT_TRIAL_GROUPS", "")
+    if env:
+        groups = int(env)
+        if groups < 1 or nd % groups != 0:
+            raise ValueError(
+                f"GRAFT_AUDIT_TRIAL_GROUPS={groups} must divide the device "
+                f"count {nd} evenly")
+        return groups
+    return 2 if nd >= 2 else 1
+
+
 def make_trial_mesh(trial_groups: int | None = None,
                     n_devices: int | None = None,
                     platform: str | None = None) -> Mesh:

@@ -111,11 +111,20 @@ def _dynamic(x) -> bool:
         isinstance(leaf, (jax.Array, np.ndarray)) for leaf in leaves)
 
 
-def lower_spec(spec):
+def lower_spec(spec, return_dynamic: bool = False,
+               keep_unused: bool = False):
     """Lower a contract's TraceSpec to an XLA program: dynamic (array)
     arguments become jit parameters, static arguments are closure
     constants — the same split every registered entrypoint's own jit
-    makes, so the compiled program is the one production calls run."""
+    makes, so the compiled program is the one production calls run.
+
+    With `return_dynamic` also returns the (dyn_args, dyn_kwargs) pytree
+    the program was lowered against — the sharding auditor pairs its
+    flattened leaves with `compiled.input_shardings` to name each operand
+    when attributing replication and per-leaf footprints. That pairing
+    needs `keep_unused=True`: by default jit PRUNES parameters the program
+    never reads from the compiled executable, which would misalign the
+    sharding leaves with the argument pytree."""
     import jax
 
     arg_dyn = [i for i, a in enumerate(spec.args) if _dynamic(a)]
@@ -131,7 +140,11 @@ def lower_spec(spec):
         kw.update(dyn_kw)
         return spec.fn(*full, **kw)
 
-    return jax.jit(call).lower(dyn_args, dyn_kwargs)
+    lowered = jax.jit(call, keep_unused=keep_unused).lower(
+        dyn_args, dyn_kwargs)
+    if return_dynamic:
+        return lowered, (dyn_args, dyn_kwargs)
+    return lowered
 
 
 def entrypoint_cost(contract) -> dict:

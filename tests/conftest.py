@@ -46,7 +46,7 @@ _ENV_SURFACE = (
     "PEERS", "CONNECTTO", "MUXER", "FRAGMENTS", "SHADOWENV", "SERVICE",
     "MAXCONNECTIONS", "SELFTRIGGER", "PEER_ID_OFFSET", "FILEPATH",
     "PUBLISHERS", "NODE_ROLE", "MOUNTSMIX", "USESMIX", "NUMMIX", "MIXD",
-    "PORT", "SIMBACKEND",
+    "PORT", "SIMBACKEND", "GRAFT_AUDIT_TRIAL_GROUPS",
 )
 
 

@@ -210,13 +210,13 @@ def test_bench_tripwire_is_keyed_per_config(tmp_path):
     # Workload-identity changes ride the key: the exact-default flip added
     # the mode suffix, the cross-protocol DHT probe the -dht suffix, and
     # the resident-service probe the -svc suffix, the batched-dispatch
-    # flip the dispatch-mode suffix (ISSUE 14), and the adaptive-attacker
-    # probe the -adaptive suffix (ISSUE 15) — each opens a FRESH
-    # bucket, so the first run of a new shape compares against nothing
-    # instead of tripping a false regression against committed rows of
-    # the old shape
+    # flip the dispatch-mode suffix (ISSUE 14), the adaptive-attacker
+    # probe the -adaptive suffix (ISSUE 15), and the mega-round scan flip
+    # the -fused suffix (ISSUE 16) — each opens a FRESH bucket, so the
+    # first run of a new shape compares against nothing instead of
+    # tripping a false regression against committed rows of the old shape
     assert bench.BENCH_CONFIG == \
-        "n100000-r300-m3-exact-dht-svc-batched-adaptive"
+        "n100000-r300-m3-exact-dht-svc-batched-adaptive-fused"
     assert bench.best_committed_peer_rounds(
         config_key=bench.BENCH_CONFIG) is None
     assert bench._config_key_of(

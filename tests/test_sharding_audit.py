@@ -1,0 +1,399 @@
+"""Tier-1 gate + golden fixtures for the sharding auditor (GA-S rules).
+
+Layers mirror tests/test_graft_audit.py:
+
+  1. The live window registry must audit CLEAN under the GA-S engine on
+     the 8-device virtual mesh — with the legacy baseline's deliberate
+     graph replication surfacing as a PINNED waiver, never silently.
+  2. Golden bad/clean contract pairs traced in-test per GA-S rule,
+     including the replicated-constant mutant (GA-S001) and the
+     donation-dropped mutant (GA-S005) — the pass must discriminate.
+  3. The rung predictor: held-out validation within 10% at the largest
+     fit point, and the committed RUNG_1M.json certificate stays
+     consistent with the modeled v5e-8.
+  4. CLI surface: --sharding report block, mutant exit codes, and
+     --format github annotation lines.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from dst_libp2p_test_node_tpu.analysis import (
+    EntrypointContract,
+    TraceSpec,
+    audit_sharding_contract,
+    audit_sharding_contracts,
+    github_annotations,
+    predict_rung_certificate,
+)
+from dst_libp2p_test_node_tpu.analysis.report import Violation
+from dst_libp2p_test_node_tpu.analysis.registry import default_contracts
+from dst_libp2p_test_node_tpu.parallel.sharding import make_peer_mesh
+
+REPO = Path(__file__).resolve().parents[1]
+
+WINDOW_NAMES = ("adversary/adaptive_window", "faults/churn_window")
+
+
+def _rules_of(violations):
+    return sorted({v.rule for v in violations})
+
+
+def _contract(name, fn, args, **kw):
+    return EntrypointContract(
+        name=name, build=lambda: TraceSpec(fn, args), **kw)
+
+
+# ---------------------------------------------------------------- layer 1:
+# the live registry's window family audits clean (the tier-1 gate)
+
+
+@pytest.fixture(scope="module")
+def window_audit():
+    contracts = [c for c in default_contracts()
+                 if c.name.startswith("campaign/") or c.name in WINDOW_NAMES]
+    return contracts, audit_sharding_contracts(contracts)
+
+
+def test_live_window_registry_audits_clean(window_audit):
+    contracts, (violations, _waived, facts) = window_audit
+    assert violations == [], [v.to_dict() for v in violations]
+    errors = {n: f["error"] for n, f in facts.items() if "error" in f}
+    assert not errors, errors
+    assert len(facts) == len(contracts) >= 6
+
+
+def test_legacy_baseline_replication_is_pinned_not_silent(window_audit):
+    """The nested=False layout replicates the epoch graph by design; the
+    auditor must SEE that (GA-S001) and route it through the pinned
+    waiver, with the rationale carried into the report."""
+    _, (_violations, waived, facts) = window_audit
+    pinned = {(w["entrypoint"], w["rule"]) for w in waived}
+    assert pinned == {("campaign/attack_window_sharded", "GA-S001")}
+    assert all(w["rationale"] for w in waived)
+    names = {r["name"]
+             for r in facts["campaign/attack_window_sharded"][
+                 "replicated_operands"]}
+    assert any("conns" in n for n in names)
+
+
+def test_nested_window_partitions_and_declared_collectives(window_audit):
+    """The nested program must actually partition over every device, and
+    every collective kind it compiles to must be in the declared set."""
+    contracts, (_v, _w, facts) = window_audit
+    by_name = {c.name: c for c in contracts}
+    for name in ("campaign/attack_window_nested",
+                 "campaign/faulted_window_nested",
+                 "campaign/dht_attack_window"):
+        f = facts[name]
+        assert f["num_partitions"] == jax.device_count(), (name, f)
+        assert set(f["collectives"]) <= set(by_name[name].collectives)
+        assert f["replicated_operands"] == [], (name, f)
+        assert 0 < f["collective_bytes"] \
+            <= by_name[name].collective_bytes_budget
+        assert f["memory"]["peak"] <= by_name[name].hbm_budget_bytes
+
+
+@pytest.mark.skipif(jax.device_count() != 8,
+                    reason="both grid aspects need the 8-device mesh")
+def test_nested_window_audits_clean_on_4x2_grid(monkeypatch):
+    """GRAFT_AUDIT_TRIAL_GROUPS=4 flips the audit grid to 4 trial groups
+    x 2-wide peer submeshes; the contract must stay clean on BOTH aspect
+    ratios (CI runs 2x4 and 4x2 explicitly)."""
+    monkeypatch.setenv("GRAFT_AUDIT_TRIAL_GROUPS", "4")
+    c = next(c for c in default_contracts()
+             if c.name == "campaign/attack_window_nested")
+    violations, waived, facts = audit_sharding_contract(c)
+    assert violations == [], [v.to_dict() for v in violations]
+    assert waived == []
+    assert facts["num_partitions"] == 8
+
+
+# ---------------------------------------------------------------- layer 2:
+# golden bad/clean contract pairs per GA-S rule (traced in-test)
+
+
+def _table_fixture(mesh, *, table_replicated):
+    """(fn, args) with a 16 KiB lookup table committed either replicated
+    (the GA-S001 mutant) or row-sharded (the clean twin) onto the mesh."""
+    rows = NamedSharding(mesh, P("peers"))
+    rep = NamedSharding(mesh, P())
+    x = jax.device_put(jnp.ones((64, 8), jnp.float32), rows)
+    table = jax.device_put(jnp.ones((64, 64), jnp.float32),
+                           rep if table_replicated else rows)
+
+    def fn(x, table):
+        return x * 2.0 + table[0, 0]
+
+    return fn, (x, table)
+
+
+def _gather_fixture(mesh, *, replicate_out):
+    """(fn, args): row-sharded input; constraining the output replicated
+    forces GSPMD to emit an all-gather (the GA-S002/S003 trigger)."""
+    rows = NamedSharding(mesh, P("peers"))
+    rep = NamedSharding(mesh, P())
+    x = jax.device_put(jnp.ones((64, 64), jnp.float32), rows)
+
+    def fn(x):
+        y = x * 2.0
+        if replicate_out:
+            y = jax.lax.with_sharding_constraint(y, rep)
+        return y
+
+    return fn, (x,)
+
+
+def test_ga_s001_replicated_constant_mutant_fires():
+    fn, args = _table_fixture(make_peer_mesh(), table_replicated=True)
+    c = _contract("fixture/replicated-table", fn, args)
+    violations, waived, facts = audit_sharding_contract(c)
+    assert _rules_of(violations) == ["GA-S001"]
+    assert waived == []
+    assert facts["replicated_operands"], facts
+    # the 16 KiB table is the flagged operand, named by its pytree path
+    assert any("[1]" in v.message for v in violations)
+
+
+def test_ga_s001_clean_when_table_sharded():
+    fn, args = _table_fixture(make_peer_mesh(), table_replicated=False)
+    c = _contract("fixture/sharded-table", fn, args)
+    violations, _waived, facts = audit_sharding_contract(c)
+    assert violations == []
+    assert facts["replicated_operands"] == []
+
+
+def test_ga_s001_waiver_moves_finding_to_waived_block():
+    fn, args = _table_fixture(make_peer_mesh(), table_replicated=True)
+    c = _contract("fixture/replicated-table-waived", fn, args,
+                  waivers=(("GA-S001", "equality baseline by design"),))
+    violations, waived, _facts = audit_sharding_contract(c)
+    assert violations == []
+    assert [w["rule"] for w in waived] == ["GA-S001"]
+    assert waived[0]["rationale"] == "equality baseline by design"
+
+
+def test_ga_s002_undeclared_collective_fires():
+    fn, args = _gather_fixture(make_peer_mesh(), replicate_out=True)
+    c = _contract("fixture/undeclared-gather", fn, args,
+                  collectives=frozenset())
+    violations, _w, facts = audit_sharding_contract(c)
+    assert _rules_of(violations) == ["GA-S002"]
+    assert "all-gather" in facts["collectives"]
+
+
+def test_ga_s002_clean_when_declared():
+    fn, args = _gather_fixture(make_peer_mesh(), replicate_out=True)
+    c = _contract("fixture/declared-gather", fn, args,
+                  collectives=frozenset({"all-gather"}))
+    violations, _w, _f = audit_sharding_contract(c)
+    assert violations == []
+
+
+def test_ga_s003_collective_bytes_over_budget_fires():
+    fn, args = _gather_fixture(make_peer_mesh(), replicate_out=True)
+    c = _contract("fixture/gather-over-budget", fn, args,
+                  collectives=frozenset({"all-gather"}),
+                  collective_bytes_budget=128)
+    violations, _w, facts = audit_sharding_contract(c)
+    assert _rules_of(violations) == ["GA-S003"]
+    assert facts["collective_bytes"] > 128
+
+
+def test_ga_s003_clean_under_budget():
+    fn, args = _gather_fixture(make_peer_mesh(), replicate_out=True)
+    c = _contract("fixture/gather-under-budget", fn, args,
+                  collectives=frozenset({"all-gather"}),
+                  collective_bytes_budget=1 << 20)
+    violations, _w, _f = audit_sharding_contract(c)
+    assert violations == []
+
+
+def test_ga_s004_peak_memory_over_budget_fires():
+    fn, args = _gather_fixture(make_peer_mesh(), replicate_out=False)
+    c = _contract("fixture/peak-over-budget", fn, args,
+                  hbm_budget_bytes=64)
+    violations, _w, facts = audit_sharding_contract(c)
+    assert _rules_of(violations) == ["GA-S004"]
+    assert facts["memory"]["peak"] > 64
+
+
+def test_ga_s004_clean_under_budget():
+    fn, args = _gather_fixture(make_peer_mesh(), replicate_out=False)
+    c = _contract("fixture/peak-under-budget", fn, args,
+                  hbm_budget_bytes=1 << 26)
+    violations, _w, _f = audit_sharding_contract(c)
+    assert violations == []
+
+
+def _strided(x):
+    return x[::2] * 2.0
+
+
+def _aliasable(x):
+    return x + 1.0
+
+
+def test_ga_s005_donation_dropped_mutant_fires():
+    """Donation declared on a strided-slice output: the lowering accepts
+    the donation but XLA cannot alias the buffers, so the COMPILED module
+    carries no input_output_alias — exactly the stage GA-J004 cannot see."""
+    c = _contract("fixture/donation-dropped", _strided,
+                  (jnp.ones((64, 64), jnp.float32),), donate=(0,))
+    violations, _w, facts = audit_sharding_contract(c)
+    assert _rules_of(violations) == ["GA-S005"]
+    assert facts["donation_aliased"] is False
+
+
+def test_ga_s005_clean_when_aliased():
+    c = _contract("fixture/donation-aliased", _aliasable,
+                  (jnp.ones((64, 64), jnp.float32),), donate=(0,))
+    violations, _w, facts = audit_sharding_contract(c)
+    assert violations == []
+    assert facts["donation_aliased"] is True
+
+
+# ---------------------------------------------------------------- layer 3:
+# the rung predictor
+
+
+def test_rung_predictor_heldout_validation_within_10pct():
+    """Fit on the smaller peer counts, hold out the largest: the fitted
+    per-device footprint must match the directly-lowered one within 10%
+    (the acceptance bar), and the certificate must be strict JSON with
+    per-leaf attribution."""
+    cert = predict_rung_certificate(peer_counts=(64, 128, 256), steps=2)
+    assert cert["validation"]["within_10pct"], cert["validation"]
+    assert cert["verdict"] in ("fits", "does-not-fit")
+    assert cert["leaves"], "per-leaf attribution missing"
+    top = cert["leaves"][0]
+    assert top["predicted_per_device_bytes"] > 0
+    assert top["rung_partitions"] in (1, 2, 4, 8)
+    total = cert["predicted_per_device"]["total"]
+    assert total > 0
+    assert (cert["verdict"] == "fits") == (
+        total <= cert["modeled_device"]["hbm_bytes_per_chip"])
+    json.dumps(cert, allow_nan=False, sort_keys=True)  # strict-JSON safe
+
+
+def test_committed_rung_certificate_is_consistent():
+    """RUNG_1M.json is the committed compile-time verdict for the
+    ATTACK_RUNG_PEERS config on a modeled v5e-8: concrete, validated, and
+    attributed per leaf."""
+    cert = json.loads((REPO / "RUNG_1M.json").read_text())
+    assert cert["rung"]["peers"] == 1048576
+    assert cert["rung"]["scenario"] == "sybil_graft_flood"
+    assert cert["modeled_device"] == {
+        "name": "v5e-8", "chips": 8, "hbm_bytes_per_chip": 16 * 2**30}
+    assert cert["validation"]["within_10pct"]
+    assert cert["verdict"] in ("fits", "does-not-fit")
+    total = cert["predicted_per_device"]["total"]
+    assert (cert["verdict"] == "fits") == (total <= 16 * 2**30)
+    assert len(cert["leaves"]) >= 10
+    assert sum(leaf["predicted_per_device_bytes"]
+               for leaf in cert["leaves"]) == pytest.approx(
+        cert["predicted_per_device"]["arguments"], rel=0.01)
+
+
+# ---------------------------------------------------------------- layer 4:
+# CLI surface
+
+
+def test_github_annotation_lines_escape_and_anchor():
+    v = Violation(rule="GA-S002", file="pkg/x.py", line=10,
+                  message="bad % and\nnewline", entrypoint="c/n")
+    w = [{"rule": "GA-S001", "file": "pkg/y.py", "line": 2,
+          "message": "replicated", "rationale": "by design"}]
+    lines = github_annotations([v], w)
+    assert lines[0].startswith(
+        "::error file=pkg/x.py,line=10,title=GA-S002 undeclared-collective::")
+    assert "%25" in lines[0] and "%0A" in lines[0]
+    assert "\n" not in lines[0]
+    assert lines[1].startswith("::notice file=pkg/y.py,line=2,")
+    assert "by design" in lines[1]
+
+
+def _run_lint_inprocess(monkeypatch, capsys, contracts, argv):
+    from dst_libp2p_test_node_tpu import cli
+    from dst_libp2p_test_node_tpu.analysis import registry
+
+    monkeypatch.setattr(registry, "default_contracts", lambda: contracts)
+    rc = cli.cmd_lint(argv)
+    return rc, capsys.readouterr().out
+
+
+def test_lint_sharding_exits_nonzero_on_each_mutant(monkeypatch, capsys):
+    """Acceptance: `lint --sharding` nonzero on every GA-S001..5 mutant."""
+    mesh = make_peer_mesh()
+    rep_fn, rep_args = _table_fixture(mesh, table_replicated=True)
+    ag_fn, ag_args = _gather_fixture(mesh, replicate_out=True)
+    sh_fn, sh_args = _gather_fixture(mesh, replicate_out=False)
+    mutants = {
+        "GA-S001": _contract("m/s001", rep_fn, rep_args),
+        "GA-S002": _contract("m/s002", ag_fn, ag_args,
+                             collectives=frozenset()),
+        "GA-S003": _contract("m/s003", ag_fn, ag_args,
+                             collectives=frozenset({"all-gather"}),
+                             collective_bytes_budget=128),
+        "GA-S004": _contract("m/s004", sh_fn, sh_args,
+                             hbm_budget_bytes=64),
+        "GA-S005": _contract("m/s005", _strided,
+                             (jnp.ones((64, 64), jnp.float32),),
+                             donate=(0,)),
+    }
+    for rule, mutant in mutants.items():
+        rc, out = _run_lint_inprocess(
+            monkeypatch, capsys, [mutant],
+            ["--no-ast", "--no-jaxpr", "--sharding"])
+        assert rc == 1, (rule, out)
+        report = json.loads(out)
+        assert rule in report["counts"], (rule, report["counts"])
+
+
+def test_lint_sharding_github_format_prints_annotations(monkeypatch, capsys):
+    fn, args = _table_fixture(make_peer_mesh(), table_replicated=True)
+    mutant = _contract("m/s001", fn, args)
+    rc, out = _run_lint_inprocess(
+        monkeypatch, capsys, [mutant],
+        ["--no-ast", "--no-jaxpr", "--sharding", "--format", "github"])
+    assert rc == 1
+    lines = out.splitlines()
+    assert lines[0].startswith("::error ")
+    # the strict-JSON report follows the annotation lines
+    report = json.loads("\n".join(
+        lines[next(i for i, ln in enumerate(lines)
+                   if ln.lstrip().startswith("{")):]))
+    assert report["clean"] is False
+
+
+def test_lint_cli_sharding_clean_subprocess(tmp_path):
+    """End-to-end CLI: the live heartbeat contracts audit clean under
+    --sharding, the report carries the sharding block, and --out/--rung
+    files are strict JSON."""
+    out_path = tmp_path / "report.json"
+    proc = subprocess.run(
+        [sys.executable, "-m", "dst_libp2p_test_node_tpu", "lint",
+         "--no-ast", "--no-jaxpr", "--sharding", "--only", "heartbeat_step",
+         "--format", "github", "--out", str(out_path)],
+        capture_output=True, text=True, cwd=str(REPO),
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    # clean run: no ::error annotations on stdout
+    assert not any(ln.startswith("::error") for ln in
+                   proc.stdout.splitlines())
+    report = json.loads(out_path.read_text())
+    assert report["clean"] is True
+    assert set(report["sharding"]) == {"heartbeat_step",
+                                       "heartbeat_step/evict"}
+    for facts in report["sharding"].values():
+        assert facts["donation_aliased"] is True
