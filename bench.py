@@ -96,9 +96,13 @@ SERVICE_DISPATCH_MODE = "batched"
 # across the flip (fused_round_s vs hb_s/disseminate_s), so a mode flip
 # opens a fresh tripwire bucket instead of comparing across regimes.
 FUSED_ROUNDS = os.environ.get("BENCH_FUSED", "1") == "1"
+# the "-arena" suffix keys the protocol-arena probe (ISSUE 19) the same
+# way: a run that also races GossipSub against the episub tree backend
+# (runtime/campaign.run_arena_campaign) opens a fresh tripwire bucket
+# instead of comparing against pre-arena artifacts
 BENCH_CONFIG = (f"n{N_PEERS}-r{HB_ROUNDS}-m{MESSAGES}-{DELIVERY_MODE}"
                 f"-dht-svc-{SERVICE_DISPATCH_MODE}-adaptive"
-                + ("-fused" if FUSED_ROUNDS else ""))
+                + ("-fused" if FUSED_ROUNDS else "") + "-arena")
 
 
 def attribution_split(
@@ -714,6 +718,64 @@ def main() -> None:
     assert np.isfinite(adaptive_attack_trials_per_s) \
         and adaptive_attack_trials_per_s > 0.0
 
+    # protocol-arena probe (ISSUE 19, runtime/campaign.run_arena_campaign):
+    # one small DEDICATED paired campaign — GossipSub vs the episub tree
+    # backend on identical epoch graphs, traffic, and the armed adaptive
+    # attacker — timed end-to-end (compile + trials + publish); the shape
+    # is fixed (not N_PEERS-scaled) so the probe costs the same on every
+    # rung. Pre-emit gates pin the trade the arena exists to measure:
+    # both protocols must actually deliver on the benign row, and the
+    # tree's eager push must undercut the mesh's duplicate-heavy benign
+    # bandwidth — an arena where either fails timed a broken backend,
+    # not a protocol race.
+    from dst_libp2p_test_node_tpu.config.topology import (
+        TopoParams as _ArenaTopo)
+    from dst_libp2p_test_node_tpu.ops.adversary import (
+        AdversaryParams as _ArenaAdversary)
+    from dst_libp2p_test_node_tpu.runtime.campaign import (
+        CampaignConfig, attack_gossipsub, run_arena_campaign)
+    from dst_libp2p_test_node_tpu.runtime.simulator import ExperimentConfig
+
+    arena_cfg = CampaignConfig(
+        scenario="sybil_graft_flood",
+        fractions=(0.25,),
+        seeds=(0,),
+        experiment=ExperimentConfig(
+            topo=_ArenaTopo(network_size=64, anchor_stages=3,
+                            msg_size_bytes=2000, messages=2,
+                            delay_seconds=0.5),
+            connect_to=8,
+            gossipsub=attack_gossipsub(flood_publish=False),
+            publisher_id=4,
+            warmup_s=8.0,
+            seed=0,
+        ),
+        adversary=_ArenaAdversary(
+            scenario="sybil_graft_flood",
+            adaptive=AdaptivePolicy(enabled=True)),
+        attack_heartbeats=6,
+    )
+    t1 = time.time()
+    arena = run_arena_campaign(
+        arena_cfg, scenarios=("benign", "sybil_graft_flood"))
+    arena_wall_s = time.time() - t1
+    arena_trials_per_s = len(arena["trials"]) / arena_wall_s
+    arena_rows = {(r["scenario"], r["protocol"]): r for r in arena["rows"]}
+    bw_gossip = arena_rows[("benign", "gossipsub")]["bandwidth_bytes"]
+    bw_episub = arena_rows[("benign", "episub")]["bandwidth_bytes"]
+    for proto in arena["protocols"]:
+        cov = arena_rows[("benign", proto)]["coverage"]
+        assert cov >= 0.95, (
+            f"arena benign coverage {cov:.3f} < 0.95 for {proto}: the "
+            "backend never converged on the no-attacker row; the probe "
+            "timed a broken protocol, not a race")
+    assert bw_episub < bw_gossip, (
+        f"arena benign bandwidth episub {bw_episub:.0f} >= gossipsub "
+        f"{bw_gossip:.0f}: the tree's eager push stopped undercutting the "
+        "mesh's duplicate traffic — the Topiary trade the arena measures "
+        "is gone")
+    assert np.isfinite(arena_trials_per_s) and arena_trials_per_s > 0.0
+
     # resident-service probe (ARCHITECTURE §16): drive the in-process
     # admission/dispatch path at 2x the dispatcher's per-round capacity on
     # a small dedicated multitopic sim. requests_per_s is the service-mode
@@ -949,6 +1011,26 @@ def main() -> None:
                 "throttled_hb_total": throttled_total,
                 "viol_est_max": round(viol_est_max, 3),
                 "attacker_score": round(adaptive_score, 2),
+            },
+            # protocol-arena probe: one paired GossipSub-vs-episub
+            # campaign on a fixed small shape (benign + armed adaptive
+            # graft-flood), timed end-to-end; the benign bandwidth pair
+            # is the pre-emit-gated Topiary trade and the win counts are
+            # the artifact's headline
+            "arena_trials_per_s": round(arena_trials_per_s, 3),
+            "arena": {
+                "peers": arena["network_size"],
+                "scenarios": list(arena["scenarios"]),
+                "seeds": list(arena["seeds"]),
+                "attack_heartbeats": arena["attack_heartbeats"],
+                "trials": len(arena["trials"]),
+                "wall_s": round(arena_wall_s, 3),
+                "benign_bandwidth_bytes": {
+                    "gossipsub": round(bw_gossip, 1),
+                    "episub": round(bw_episub, 1),
+                },
+                "win_counts": arena["win_counts"],
+                "ties": arena["ties"],
             },
             # resident-service probe: in-process submit()/pump() at 2x
             # dispatcher capacity (runtime/traffic.py ETH2-style mix); the

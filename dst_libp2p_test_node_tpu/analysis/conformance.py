@@ -49,6 +49,7 @@ __all__ = [
     "differential_round", "differential_adaptive_round",
     "run_scenario_differential", "run_adaptive_differential",
     "run_faults_differential", "run_churn_differential",
+    "run_og_differential",
     "cross_fragment_check", "load_waivers", "classify",
     "conformance_certificate", "certificate_entry", "write_certificate",
 ]
@@ -428,6 +429,96 @@ def run_churn_differential(n=48, connect_to=8, seed=0, steps=8,
         steps=steps, warm_steps=warm_steps, params=params, fraction=0.0)
 
 
+def run_og_differential(n=48, connect_to=8, seed=0, steps=8, warm_steps=4,
+                        fraction=0.35, og_threshold=-1.0, tie_highest=False):
+    """Opportunistic-grafting differential (the registry-refactor gate's
+    spec-depth rung): og ARMED over a sybil graft flood whose violation
+    penalties drag the honest mesh median under `og_threshold`, so the
+    v1.1 og rule — median probe, strict-above-median eligibility, top-2 by
+    score — fires on both sides and the walk pins the engine to the
+    spec's tie policy (ops/spec.opportunistic_graft_candidates: lowest
+    neighbor slot among equal scores, the executable resolution of the
+    ACL2s nondeterministic choice).
+
+    The fixture is self-checking: it RAISES unless (a) the og branch
+    actually fired during the walk and (b) at least one fired round held
+    a DECISIVE tie (the lowest-slot and highest-slot resolutions select
+    different edges) — otherwise a bitwise-clean differential would say
+    nothing about the tie policy. `tie_highest=True` runs the spec side
+    under the other admissible resolution; the divergence it must produce
+    is the discrimination proof (tests/test_conformance.py)."""
+    jax, jnp = _jax()
+    from ..ops.adversary import run_attacked_heartbeats
+    from ..ops.graph import build_connection_graph
+    from ..ops.spec import (_validity, host_state,
+                            opportunistic_graft_candidates,
+                            spec_adversary_round, spec_heartbeat, spec_score)
+    from ..ops.state import SimParams
+
+    g = build_connection_graph(n, connect_to, seed=seed)
+    params = SimParams(n=n, capacity=g.capacity,
+                       opportunistic_graft_threshold=og_threshold, **ARMED)
+    g, params, adv, a, state, att, hosts = _fixture(
+        "sybil_graft_flood", n, connect_to, seed, params, None, warm_steps,
+        fraction)
+    state0 = state
+    st = host_state(state)
+
+    divs = []
+    div_steps = 0
+    fired = False
+    decisive = False
+    for i in range(steps):
+        # fixture-quality probe (advisory, pre-step state): would the og
+        # rule fire here, and does the tie policy decide the selection?
+        valid = _validity(st, hosts["conns"], hosts["rev"], st["alive"],
+                          None)
+        scores = spec_score(st, params)
+        pmesh = st["mesh_mask"] & valid
+        og_lo, _, _ = opportunistic_graft_candidates(
+            pmesh, valid, st["backoff_until"], np.float32(st["t_ms"]),
+            scores, params)
+        og_hi, _, _ = opportunistic_graft_candidates(
+            pmesh, valid, st["backoff_until"], np.float32(st["t_ms"]),
+            scores, params, highest_slot_ties=True)
+        fired = fired or bool(og_lo.any())
+        decisive = decisive or bool((og_lo != og_hi).any())
+
+        state = differential_round(state, a["conns"], a["rev"],
+                                   a["out_mask"], att, params, adv,
+                                   jnp.int32(i))
+        st = spec_heartbeat(st, hosts["conns"], hosts["rev"],
+                            hosts["out_mask"], params,
+                            og_tie_highest=tie_highest)
+        st = spec_adversary_round(st, hosts["conns"], hosts["rev"],
+                                  hosts["att"], params, adv, i)
+        step_divs = _diff_states(state, st, "opportunistic_graft", seed, i)
+        if step_divs:
+            divs.extend(step_divs)
+            div_steps += 1
+            if div_steps >= _MAX_DIV_STEPS:
+                break
+    if not fired:
+        raise RuntimeError(
+            "og differential fixture never exercised the opportunistic-"
+            "grafting branch — raise fraction or og_threshold")
+    if not decisive:
+        raise RuntimeError(
+            "og differential fixture never held a decisive score tie — "
+            "the walk cannot pin the tie policy")
+
+    if not tie_highest and div_steps < _MAX_DIV_STEPS:
+        # runner coherence, same contract as run_scenario_differential
+        final, _obs = run_attacked_heartbeats(
+            state0, a["conns"], a["rev"], a["out_mask"], att, params, adv,
+            steps)
+        ref = {f: np.asarray(getattr(state, f))
+               for f in _spec_fields() if getattr(state, f) is not None}
+        divs.extend(_diff_states(final, ref, "opportunistic_graft", seed,
+                                 steps, prefix="runner_coherence:"))
+    return divs
+
+
 def cross_fragment_check(n=64, connect_to=8, seed=0, fragments=3,
                          payload_bytes=60000, loss=0.25):
     """The `with_gossip AND fragments>1` shape (VERDICT round-5 item 6):
@@ -564,7 +655,8 @@ def certificate_entry(scenario, divergences, waivers, **meta):
 def conformance_certificate(scenarios=None, n=48, connect_to=8, seeds=(0,),
                             steps=8, warm_steps=4, waivers_path=None,
                             include_adaptive=True, include_faults=True,
-                            include_churn=True, include_gossip=True):
+                            include_churn=True, include_gossip=True,
+                            include_og=True):
     """Run the full conformance fuzz sweep and build the certificate dict:
     every attack scenario x every seed through the per-round differential,
     plus the adaptive-controller, fault-family, churn, and cross-fragment
@@ -607,6 +699,15 @@ def conformance_certificate(scenarios=None, n=48, connect_to=8, seeds=(0,),
                 warm_steps=warm_steps))
         entries.append(certificate_entry("churn", divs, waivers,
                                          seeds=list(seeds), n=n, steps=steps))
+    if include_og:
+        divs = []
+        for s in seeds:
+            divs.extend(run_og_differential(
+                n=n, connect_to=connect_to, seed=s, steps=steps,
+                warm_steps=warm_steps))
+        entries.append(certificate_entry("opportunistic_graft", divs,
+                                         waivers, seeds=list(seeds), n=n,
+                                         steps=steps))
     if include_gossip:
         divs = cross_fragment_check(seed=seeds[0])
         entries.append(certificate_entry("gossip_fragments", divs, waivers,

@@ -79,6 +79,14 @@ The registered surface mirrors the BENCH hot paths exactly:
   native/score_update     the fused Pallas scoring-update kernel in
                           interpret mode (the jaxpr carries the real
                           pallas_call on every backend)
+  episub/heartbeat_step   one episub tree round (ISSUE 19, ops/episub.py):
+                          eager tree push + lazy IHAVE repair + graylisted
+                          re-parenting, thresholds armed — exactly 1
+                          surviving cond (the shared fmd/slow decay gate)
+  protocol/arena_window   the arena's sharded episub attack window
+                          (sharded_episub_window): nested trials x peers
+                          grid like campaign/attack_window_nested, state
+                          and ctrl feeding back aval-stable
 """
 
 from __future__ import annotations
@@ -390,6 +398,54 @@ def _faulted_nested_spec() -> TraceSpec:
         args=(stacked, shared, jnp.stack(atts), jnp.stack(crs),
               jnp.stack(sds), jnp.stack(sps)),
         kwargs=dict(params=params, adv=AdversaryParams(), faults=faults,
+                    steps=3, trial_mesh=mesh, local_trials=local))
+
+
+def _episub_step_spec() -> TraceSpec:
+    from ..ops.episub import (EpisubParams, episub_heartbeat_step,
+                              init_episub_ctrl)
+
+    # graylist thresholds live: the score-gated parent-eligibility edge
+    # mask is a static compile-out under the reference defaults, and the
+    # audited program must be the armed one the arena runs
+    g, params, state, a, _ = _single_topic(**_ARMED)
+    return TraceSpec(
+        fn=episub_heartbeat_step,
+        args=(state, init_episub_ctrl(params.n), a["conns"], a["rev"],
+              a["out_mask"]),
+        kwargs=dict(params=params, ep=EpisubParams(root=3)))
+
+
+def _arena_window_spec() -> TraceSpec:
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.adversary import (AdaptivePolicy, AdversaryParams,
+                                 attacker_cohort)
+    from ..ops.episub import EpisubParams, init_episub_ctrl
+    from ..ops.state import strip_repair
+    from ..parallel.sharding import audit_trial_groups, make_trial_mesh
+    from ..runtime.campaign import sharded_episub_window
+
+    # _ARMED is repair-inert: strip host-side exactly like _episub_windows
+    g, params, state, a, _ = _single_topic(**_ARMED)
+    state, _saved = strip_repair(state)
+    groups = audit_trial_groups()
+    mesh = make_trial_mesh(groups)
+    local = 2
+    trials = groups * local
+    stack = lambda x: jnp.stack([jnp.asarray(x)] * trials)  # noqa: E731
+    stacked = jax.tree_util.tree_map(stack, state)
+    ctrls = jax.tree_util.tree_map(stack, init_episub_ctrl(params.n))
+    att = jnp.stack([
+        jnp.asarray(attacker_cohort(params.n, 0.25, seed=s))
+        for s in range(trials)])
+    shared = {k: a[k] for k in ("conns", "rev", "out_mask")}
+    adv = AdversaryParams(adaptive=AdaptivePolicy(enabled=True))
+    return TraceSpec(
+        fn=sharded_episub_window,
+        args=(stacked, ctrls, shared, att),
+        kwargs=dict(params=params, ep=EpisubParams(root=3), adv=adv,
                     steps=3, trial_mesh=mesh, local_trials=local))
 
 
@@ -966,4 +1022,40 @@ def default_contracts() -> list[EntrypointContract]:
                   "same steady-state-skip program the runners scan (the 4 "
                   "heartbeat conds must survive; the returned state feeds "
                   "the next round aval-stable)"),
+        EntrypointContract(
+            name="episub/heartbeat_step",
+            build=_episub_step_spec,
+            expected_conds=1,
+            feedback=[(lambda out: out[0], lambda spec: spec.args[0]),
+                      (lambda out: out[1], lambda spec: spec.args[1])],
+            collectives=frozenset(),
+            hbm_budget_bytes=2 * 1024 * 1024,
+            notes="the episub tree round (ops/episub.py, ARCHITECTURE §21): "
+                  "eager push down the spanning tree + lazy IHAVE repair on "
+                  "non-tree edges + graylist-gated re-parenting, all dense "
+                  "masked ops — exactly one cond survives (the fmd/slow "
+                  "decay gate shared with gossipsub's scorer); state and "
+                  "ctrl both feed back aval-stable, and single-device "
+                  "tracing must stay collective-free"),
+        EntrypointContract(
+            name="protocol/arena_window",
+            build=_arena_window_spec,
+            expected_conds=None,
+            feedback=[(lambda out: out[0][0], lambda spec: spec.args[0]),
+                      (lambda out: out[0][1], lambda spec: spec.args[1])],
+            retrace_budget=1,
+            collectives=frozenset({"all-gather", "all-reduce",
+                                   "collective-permute"}),
+            collective_bytes_budget=64 * 1024,
+            hbm_budget_bytes=2 * 1024 * 1024,
+            notes="the arena's sharded episub attack window "
+                  "(runtime/campaign.py sharded_episub_window), nested "
+                  "trial x group sharding like campaign/attack_window_"
+                  "nested; ISSUE 19's 'retrace budget 0' reads as zero "
+                  "EXTRA retraces — explicit in/out_shardings force one "
+                  "fresh jit closure per window, the house budget for "
+                  "every nested window (retrace_budget=1); state and ctrl "
+                  "feed back aval-stable (actrl is window-internal, no "
+                  "input slot), and per-trial collective traffic stays "
+                  "under the attack-window byte budget"),
     ]

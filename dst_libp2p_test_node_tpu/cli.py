@@ -26,6 +26,11 @@ Subcommands:
                grid over mesh-degree/scoring knobs vs the adaptive attacker,
                report the coverage/bandwidth/recovery-time front and which
                configurations dominate the defaults.
+  arena      — protocol arena (runtime/campaign.run_arena_campaign):
+               GossipSub vs episub (ops/episub.py, Topiary-style tree) on
+               identical graphs/traffic/fault cohorts under the same
+               adaptive attacker; strict-JSON head-to-head artifact with
+               the per-scenario win matrix.
   kad        — role-based kad-dht workload (bootstrap/normal/probe).
   connmanager — hub-and-spoke watermark/reconnect stress workload.
   servicedisco — advertise/lookup service discovery over the DHT.
@@ -783,6 +788,108 @@ def cmd_pareto(argv: list[str]) -> int:
     return 0
 
 
+def cmd_arena(argv: list[str]) -> int:
+    """Protocol arena: race GossipSub against the episub tree backend on
+    identical epoch graphs, traffic schedules, fault cohorts, and the
+    adaptive attacker (runtime/campaign.run_arena_campaign), and report
+    the per-scenario win matrix. The benign scenario rides along by
+    default — it is the bandwidth-floor row the arena bench gate reads."""
+    p = argparse.ArgumentParser(prog="arena")
+    from .ops.adversary import ADAPTIVE_SCENARIOS
+
+    p.add_argument("--scenarios", default="benign,sybil_graft_flood",
+                   help="comma-separated scenario list; 'benign' is the "
+                   "reserved no-attacker row, the rest must be "
+                   f"adaptive-capable ({', '.join(ADAPTIVE_SCENARIOS)})")
+    p.add_argument("-n", "--peers", type=int, default=64)
+    p.add_argument("--fraction", type=float, default=0.25,
+                   help="attacker fraction for every attack scenario")
+    p.add_argument("--seeds", default="0,1")
+    p.add_argument("--messages", type=int, default=2)
+    p.add_argument("--msg-size", type=int, default=2000)
+    p.add_argument("--delay-s", type=float, default=0.5)
+    p.add_argument("--warmup-s", type=float, default=8.0)
+    p.add_argument("--attack-heartbeats", type=int, default=8)
+    p.add_argument("--connect-to", type=int, default=8)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--publisher-id", type=int, default=4)
+    p.add_argument("--throttle-margin", type=float, default=None,
+                   help="adaptive duty-cycle setpoint (0 < m < 1)")
+    p.add_argument("--lazy-degree", type=int, default=None,
+                   help="episub lazy-IHAVE budget per round (default: "
+                   "the GossipSub d_lazy derivation)")
+    p.add_argument("--trial-groups", type=int, default=None, metavar="N",
+                   help="nested trial x peer sharding for both windows "
+                   "(parallel/sharding.make_trial_mesh)")
+    p.add_argument("--json", default=None,
+                   help="write the arena artifact as strict JSON here")
+    a = p.parse_args(argv)
+
+    from .ops.adversary import AdaptivePolicy, AdversaryParams
+    from .ops.episub import EpisubParams
+    from .runtime.campaign import (
+        CampaignConfig, attack_gossipsub, run_arena_campaign)
+    from .runtime.simulator import ExperimentConfig
+    from .runtime.summarize import report_arena
+
+    scenarios = tuple(s.strip() for s in a.scenarios.split(",") if s.strip())
+    attack_scs = [s for s in scenarios if s != "benign"]
+    bad = [s for s in attack_scs if s not in ADAPTIVE_SCENARIOS]
+    if bad:
+        p.error(f"scenarios {bad} are not adaptive-capable; choose from "
+                f"'benign', {', '.join(ADAPTIVE_SCENARIOS)}")
+    if not attack_scs:
+        p.error("--scenarios needs at least one attack scenario beside "
+                "'benign' (the arena's referee is the adaptive attacker)")
+    if not 0.0 < a.fraction < 1.0:
+        p.error("--fraction must be in (0, 1)")
+    seeds = tuple(int(s) for s in a.seeds.split(",") if s.strip())
+    pol_kw: dict = {"enabled": True}
+    if a.throttle_margin is not None:
+        pol_kw["throttle_margin"] = a.throttle_margin
+    cfg = CampaignConfig(
+        scenario=attack_scs[0],
+        fractions=(a.fraction,),
+        seeds=seeds,
+        experiment=ExperimentConfig(
+            topo=TopoParams(
+                network_size=a.peers, anchor_stages=3,
+                msg_size_bytes=a.msg_size, messages=a.messages,
+                delay_seconds=a.delay_s),
+            connect_to=a.connect_to,
+            # flood_publish off: arena traffic must ride mesh_mask, the
+            # surface the two protocols differ on
+            gossipsub=attack_gossipsub(flood_publish=False),
+            publisher_id=a.publisher_id,
+            warmup_s=a.warmup_s,
+            seed=a.seed,
+        ),
+        adversary=AdversaryParams(
+            scenario=attack_scs[0], adaptive=AdaptivePolicy(**pol_kw)),
+        attack_heartbeats=a.attack_heartbeats,
+    )
+    ep = None
+    if a.lazy_degree is not None:
+        ep = EpisubParams(root=a.publisher_id % a.peers,
+                          lazy_degree=a.lazy_degree)
+    trial_mesh = None
+    if a.trial_groups is not None:
+        from .parallel.sharding import make_trial_mesh
+
+        try:
+            trial_mesh = make_trial_mesh(a.trial_groups or None)
+        except ValueError as e:
+            p.error(str(e))
+    arena = run_arena_campaign(cfg, scenarios=scenarios, ep=ep,
+                               trial_mesh=trial_mesh)
+    print(report_arena(arena), end="")
+    if a.json:
+        with open(a.json, "w") as f:
+            # strict JSON: run_arena_campaign sanitizes non-finite values
+            json.dump(arena, f, indent=2, allow_nan=False)
+    return 0
+
+
 def cmd_serve(argv: list[str]) -> int:
     """Run as a long-lived node service (the reference's steady-state node:
     HTTP /publish + /health + /ready on :8645, Prometheus on :8008), hosting
@@ -1434,6 +1541,8 @@ def main(argv: list[str] | None = None) -> int:
         return cmd_attack(rest)
     if cmd == "pareto":
         return cmd_pareto(rest)
+    if cmd == "arena":
+        return cmd_arena(rest)
     if cmd == "inject":
         return cmd_inject(rest)
     if cmd == "kad":

@@ -101,6 +101,54 @@ def _score_of(fmd, slow_penalty, params: SimParams) -> np.ndarray:
             + np.float32(params.slow_weight) * slow_penalty)
 
 
+def opportunistic_graft_candidates(mesh, valid, backoff, t, scores,
+                                   params: SimParams,
+                                   highest_slot_ties: bool = False):
+    """v1.1 opportunistic-grafting selection with the tie policy made
+    explicit — the spec-side transcription of the engine's og block
+    (ops/heartbeat.py) and of the ACL2s formalization's opportunistic-
+    grafting rule (arXiv:2311.08859).
+
+    Rule: when a row's UPPER-MEDIAN mesh score (sorted[deg // 2], the
+    libp2p implementations' median) sinks below
+    params.opportunistic_graft_threshold and the mesh is non-empty, graft
+    up to 2 eligible peers (valid, non-mesh, backoff expired) scoring
+    STRICTLY above that median, preferring the highest-scored.
+
+    Tie policy: the ACL2s model leaves the choice among equally-scored
+    candidates NONDETERMINISTIC (any maximal subset of size <= 2 is an
+    allowed successor). This executable spec — per the module-wide
+    selection-oracle convention — resolves it deterministically to the
+    LOWEST NEIGHBOR SLOT: ranks come from a stable double argsort, so
+    among equal -score keys the earlier slot wins, exactly matching the
+    engine's jnp.argsort (stable by default in JAX). Two further
+    median-rule consequences the differential pins: candidates scoring
+    EXACTLY the median are excluded (strict >), and the median index for
+    even degrees is the upper middle, not the average.
+
+    Returns (og, median, low): the (N, C) selected graft edge set and the
+    per-row median/low-quality diagnostics the caller's guards reuse."""
+    n, c = mesh.shape
+    deg = mesh.sum(axis=-1)
+    msort = np.sort(np.where(mesh, scores, BIG), axis=-1, kind="stable")
+    k_med = np.clip(deg // 2, 0, c - 1)
+    median = np.take_along_axis(msort, k_med[:, None], axis=-1)[:, 0]
+    low = ((median < np.float32(params.opportunistic_graft_threshold))
+           & (deg > 0))
+    og_elig = (valid & ~mesh & (backoff <= t)
+               & (scores > median[:, None]) & low[:, None])
+    og_prio = np.where(og_elig, -scores, BIG)
+    if highest_slot_ties:
+        # the OTHER admissible resolution of the ACL2s nondeterminism
+        # (highest slot first among equal scores) — the differential's
+        # tie-policy witness: flipping this knob must produce divergence
+        # whenever a tie was decisive, proving the walk pins the policy
+        og = (_ranks(og_prio[:, ::-1])[:, ::-1] < 2) & og_elig
+    else:
+        og = (_ranks(og_prio) < 2) & og_elig
+    return og, median, low
+
+
 def _validity(st, conns, rev, alive, edge_ok):
     nbr_ok = _nbr_pull(alive & st["subscribed"], conns, rev)
     valid = ((conns >= 0) & alive[:, None] & nbr_ok
@@ -111,7 +159,7 @@ def _validity(st, conns, rev, alive, edge_ok):
 
 
 def spec_heartbeat(st: dict, conns, rev, out_mask, params: SimParams,
-                   edge_ok=None) -> dict:
+                   edge_ok=None, og_tie_highest: bool = False) -> dict:
     """One heartbeat of the reference transition relation — the spec twin of
     ops/heartbeat.heartbeat_step on its per-step (non-deferred-decay) path.
     Branch guards mirror the engine's lax.cond predicates exactly: a guard
@@ -235,16 +283,9 @@ def spec_heartbeat(st: dict, conns, rev, out_mask, params: SimParams,
     # -- opportunistic grafting (opt-in) ------------------------------------
     og_tx_inc = og_rx_inc = zeros_n
     if params.opportunistic_graft_threshold > -9999.0:
-        deg3 = mesh.sum(axis=-1)
-        msort = np.sort(np.where(mesh, scores, BIG), axis=-1, kind="stable")
-        k_med = np.clip(deg3 // 2, 0, c - 1)
-        median = np.take_along_axis(msort, k_med[:, None], axis=-1)[:, 0]
-        low = ((median < np.float32(params.opportunistic_graft_threshold))
-               & (deg3 > 0))
-        og_elig = (valid & ~mesh & (backoff <= t)
-                   & (scores > median[:, None]) & low[:, None])
-        og_prio = np.where(og_elig, -scores, BIG)
-        og = (_ranks(og_prio) < 2) & og_elig
+        og, _, _ = opportunistic_graft_candidates(
+            mesh, valid, backoff, t, scores, params,
+            highest_slot_ties=og_tie_highest)
         if og.any():
             rx = _pull(og, conns, rev)
             mesh = (mesh | og | rx) & valid

@@ -599,9 +599,32 @@ def _run_nested_window(body, trial_mesh, n_rows: int, stacked_args: tuple,
     return jax.jit(body, in_shardings=in_sh, out_shardings=out_sh)(*args)
 
 
+def _protocol_window_runner(protocol: str, runner: str):
+    """Resolve a campaign window's heartbeat runner through the protocol
+    registry (ops/protocol.py). For "gossipsub" — the default every
+    pre-arena caller gets — the resolved field IS the module-level runner
+    object the windows used to name directly: same function object, same
+    jit cache entry, zero retraces, bit-identical
+    (tests/test_protocol_registry.py pins the `is` identity). Protocols
+    with a per-protocol ctrl carry (episub) thread it explicitly through
+    their own windows (sharded_episub_window / _episub_windows); the
+    SimState-only windows reject them rather than silently dropping the
+    carry."""
+    from ..ops.protocol import get_protocol
+
+    spec = get_protocol(protocol)
+    if spec.init_ctrl is not None:
+        raise ValueError(
+            f"protocol {protocol!r} carries a per-protocol ctrl; route it "
+            "through its ctrl-threading windows (sharded_episub_window), "
+            "not the SimState-only attack/fault windows")
+    return getattr(spec, runner)
+
+
 def sharded_attack_window(stacked, shared: dict, attackers, params, adv,
                           steps: int, trial_mesh, local_trials: int,
-                          nested: bool = True, telemetry=None):
+                          nested: bool = True, telemetry=None,
+                          protocol: str = "gossipsub"):
     """One device program over the 2-D trials x peers grid: the stacked
     batch's trial axis splits across trial groups AND each trial's peer
     rows split across the group's peer submesh. `stacked` leaves and
@@ -629,12 +652,13 @@ def sharded_attack_window(stacked, shared: dict, attackers, params, adv,
 
     from ..parallel.sharding import TRIAL_AXIS, shard_map
 
+    run_win = _protocol_window_runner(protocol, "run_adaptive_heartbeats")
     if nested:
         bf = _nested_batch_factor(trial_mesh, local_trials)
 
         def body(st, at, cn, rv, om):
             def one(s, a):
-                return run_adaptive_heartbeats(
+                return run_win(
                     s, cn, rv, om, a, params, adv, steps, batch_factor=bf,
                     telemetry=telemetry)
 
@@ -648,7 +672,7 @@ def sharded_attack_window(stacked, shared: dict, attackers, params, adv,
 
     def group(st, at, cn, rv, om):
         def one(s, a):
-            return run_adaptive_heartbeats(
+            return run_win(
                 s, cn, rv, om, a, params, adv, steps,
                 batch_factor=local_trials, telemetry=telemetry)
 
@@ -665,7 +689,8 @@ def sharded_attack_window(stacked, shared: dict, attackers, params, adv,
 
 def sharded_faulted_window(stacked, shared: dict, attackers, crash, side,
                            spike, params, adv, faults, steps: int,
-                           trial_mesh, local_trials: int, telemetry=None):
+                           trial_mesh, local_trials: int, telemetry=None,
+                           protocol: str = "gossipsub"):
     """The fault-armed nested window: per-trial crash/side/spike cohort
     masks are (T, N) peer-major exactly like the attacker masks, so they
     shard over both grid axes and the fault-scheduled scan
@@ -674,11 +699,12 @@ def sharded_faulted_window(stacked, shared: dict, attackers, crash, side,
     the vmapped single-device stack."""
     import jax
 
+    run_win = _protocol_window_runner(protocol, "run_faulted_heartbeats")
     bf = _nested_batch_factor(trial_mesh, local_trials)
 
     def body(st, at, cr, sd, sp, cn, rv, om):
         def one(s, a, c2, d2, p2):
-            return run_faulted_heartbeats(
+            return run_win(
                 s, cn, rv, om, a, params, adv, faults, c2, d2, p2, steps,
                 batch_factor=bf, telemetry=telemetry)
 
@@ -833,7 +859,7 @@ def _pad_to_groups(states: list, attackers: list, trial_mesh, extras=None):
 
 def _attack_windows(sim: Simulator, attackers, states, adv, steps: int,
                     trial_mesh=None, faults=None, fmasks=None,
-                    telemetry=None):
+                    telemetry=None, protocol: str = "gossipsub"):
     """Run the attack window for a batch of trials. With `trial_mesh` (a 2-D
     make_trial_mesh grid) the stacked batch runs as one nested-sharded
     program — trials split over the grid's trial groups, each trial's peer
@@ -856,6 +882,9 @@ def _attack_windows(sim: Simulator, attackers, states, adv, steps: int,
 
     tree = jax.tree_util.tree_map
     a = sim.arrays
+    run_adaptive = _protocol_window_runner(protocol,
+                                           "run_adaptive_heartbeats")
+    run_faulted = _protocol_window_runner(protocol, "run_faulted_heartbeats")
     adaptive = adv.adaptive.enabled
     faulted = faults is not None and faults.enabled
     if faulted and trial_mesh is not None and len(states) > 1:
@@ -879,7 +908,8 @@ def _attack_windows(sim: Simulator, attackers, states, adv, steps: int,
             (stacked, att, crs, sds, sps), a, trial_mesh, n_rows=n_rows)
         out_states, obs = sharded_faulted_window(
             stacked, shared, att, crs, sds, sps, sim.params, adv, faults,
-            steps, trial_mesh, local, telemetry=telemetry)
+            steps, trial_mesh, local, telemetry=telemetry,
+            protocol=protocol)
         obs_np = tree(np.asarray, obs)
         outs, ctrls = [], ([] if adaptive else None)
         for j in range(s_count):
@@ -894,7 +924,7 @@ def _attack_windows(sim: Simulator, attackers, states, adv, steps: int,
                       for j in range(s_count)], ctrls
     if faulted and len(states) == 1:
         m = fmasks[0]
-        st, obs = run_faulted_heartbeats(
+        st, obs = run_faulted(
             states[0], a["conns"], a["rev"], a["out_mask"], attackers[0],
             sim.params, adv, faults, m["crash"], m["side"], m["spike"],
             steps, telemetry=telemetry)
@@ -912,7 +942,7 @@ def _attack_windows(sim: Simulator, attackers, states, adv, steps: int,
         sps = jnp.stack([m["spike"] for m in fmasks])
 
         def one_f(st, at, cr, sd, sp):
-            return run_faulted_heartbeats(
+            return run_faulted(
                 st, a["conns"], a["rev"], a["out_mask"], at, sim.params,
                 adv, faults, cr, sd, sp, steps, batch_factor=s_count,
                 telemetry=telemetry)
@@ -948,7 +978,7 @@ def _attack_windows(sim: Simulator, attackers, states, adv, steps: int,
             (stacked, att), a, trial_mesh, n_rows=sim.params.n)
         out_states, obs = sharded_attack_window(
             stacked, shared, att, sim.params, adv, steps, trial_mesh, local,
-            telemetry=telemetry)
+            telemetry=telemetry, protocol=protocol)
         obs_np = tree(np.asarray, obs)
         outs, ctrls = [], ([] if adaptive else None)
         for j in range(s_count):
@@ -962,7 +992,7 @@ def _attack_windows(sim: Simulator, attackers, states, adv, steps: int,
         return outs, [{k: v[j] for k, v in obs_np.items()}
                       for j in range(s_count)], ctrls
     if len(states) == 1:
-        st, obs = run_adaptive_heartbeats(
+        st, obs = run_adaptive(
             states[0], a["conns"], a["rev"], a["out_mask"], attackers[0],
             sim.params, adv, steps, telemetry=telemetry)
         ctrls = None
@@ -975,7 +1005,7 @@ def _attack_windows(sim: Simulator, attackers, states, adv, steps: int,
     att = jnp.stack(attackers)
 
     def one(st, at):
-        return run_adaptive_heartbeats(
+        return run_adaptive(
             st, a["conns"], a["rev"], a["out_mask"], at, sim.params, adv,
             steps, batch_factor=s_count, telemetry=telemetry)
 
@@ -1726,5 +1756,510 @@ def run_defense_sweep(
         "pareto": [i for i in range(len(rows)) if bool(front[i])],
         "default_index": default_index,
         "beats_default": beats,
+        "wall_s": time.time() - t0,
+    })
+
+
+# ------------------------------------------------------- protocol arena
+
+# objective -> direction, in artifact column order. Coverage and the two
+# latency quantiles are what a dissemination protocol exists to deliver;
+# bandwidth_bytes is what GossipSub's mesh redundancy spends to deliver
+# them (the axis Topiary-style trees exist to shrink, arXiv:2312.06800);
+# recovery_time_ms is how fast the protocol sheds the adaptive cohort
+# once compromised. The win matrix scores every objective per scenario —
+# no scalarization: the artifact reports who wins WHAT, not who "wins".
+ARENA_OBJECTIVES = {
+    "coverage": "max",
+    "bandwidth_bytes": "min",
+    "latency_p50_ms": "min",
+    "latency_p99_ms": "min",
+    "recovery_time_ms": "min",
+}
+
+# relative tolerance under which an objective cell scores a tie: means
+# this close are sampling noise at arena seed counts, not a win
+ARENA_REL_TOL = 1e-3
+
+
+def sharded_episub_window(stacked, ctrls, shared: dict, attackers, params,
+                          ep, adv, steps: int, trial_mesh,
+                          local_trials: int, telemetry=None):
+    """The episub arena window on the 2-D trials x peers grid: the
+    EpisubCtrl carry's leaves are (T, N) peer-major exactly like the
+    attacker masks, so the tree controller nested-shards through the same
+    shape rule as the state (parallel/sharding.nested_batch_shardings)
+    and hop relaxation / re-parenting run peer-partitioned inside each
+    trial group. Mirrors sharded_attack_window's nested branch; there is
+    no legacy trial-only branch because this window postdates the PR-5
+    formulation (tests/test_episub.py pins sharded == vmapped on both
+    grid orientations instead)."""
+    import jax
+
+    from ..ops.episub import run_episub_adaptive_heartbeats
+
+    bf = _nested_batch_factor(trial_mesh, local_trials)
+
+    def body(st, ct, at, cn, rv, om):
+        def one(s, c, a):
+            return run_episub_adaptive_heartbeats(
+                s, c, cn, rv, om, a, params, ep, adv, steps,
+                batch_factor=bf, telemetry=telemetry)
+
+        return jax.vmap(one)(st, ct, at)
+
+    n_rows = shared["conns"].shape[0]
+    return _run_nested_window(body, trial_mesh, n_rows,
+                              (stacked, ctrls, attackers), shared)
+
+
+def _episub_windows(sim: Simulator, ep, attackers, states, ctrls, adv,
+                    steps: int, trial_mesh=None, faults=None, fmasks=None,
+                    telemetry=None):
+    """Run the episub attack window for a batch of trials: the
+    ctrl-threading mirror of _attack_windows. Returns (states, ctrls,
+    obs_dicts) in input order; an armed adv.adaptive widens the runner
+    carry with the attacker controller, which the arena drops — it reads
+    protocol state only, and unlike run_campaign it has no recovery legs
+    to thread the controller into. Fault-armed cells run vmapped (no
+    sharded fault variant: arena fault cells are smoke-scale); plain
+    windows ride the nested grid when trial_mesh is given."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.episub import (run_episub_adaptive_heartbeats,
+                              run_episub_faulted_heartbeats)
+    from ..ops.state import repair_inert, restore_repair, strip_repair
+
+    tree = jax.tree_util.tree_map
+    a = sim.arrays
+    adaptive = adv.adaptive.enabled
+    faulted = faults is not None and faults.enabled
+    s_count = len(states)
+
+    def _unpack(out):
+        # (state, ctrl[, actrl]) -> (state, ctrl): the arena drops actrl
+        return (out[0], out[1]) if adaptive else out
+
+    if faulted:
+        stacked = tree(lambda *xs: jnp.stack(xs), *states)
+        ctk = tree(lambda *xs: jnp.stack(xs), *ctrls)
+        att = jnp.stack(attackers)
+        crs = jnp.stack([m["crash"] for m in fmasks])
+        sds = jnp.stack([m["side"] for m in fmasks])
+        sps = jnp.stack([m["spike"] for m in fmasks])
+
+        def one_f(st, ct, at, cr, sd, sp):
+            return run_episub_faulted_heartbeats(
+                st, ct, a["conns"], a["rev"], a["out_mask"], at,
+                sim.params, ep, adv, faults, cr, sd, sp, steps,
+                batch_factor=s_count, telemetry=telemetry)
+
+        out, obs = jax.vmap(one_f)(stacked, ctk, att, crs, sds, sps)
+        o_states, o_ctrls = _unpack(out)
+        obs_np = tree(np.asarray, obs)
+        return (
+            [tree(lambda x, j=j: x[j], o_states) for j in range(s_count)],
+            [tree(lambda x, j=j: x[j], o_ctrls) for j in range(s_count)],
+            [{k: v[j] for k, v in obs_np.items()} for j in range(s_count)],
+        )
+    if trial_mesh is not None and s_count > 1:
+        from ..parallel.sharding import place_trial_batch
+
+        states, attackers, ctrls, local = _pad_to_groups(
+            states, attackers, trial_mesh, extras=ctrls)
+        # strip host-side ONCE for the batch, same as _attack_windows
+        saved = None
+        if repair_inert(sim.params):
+            pairs = [strip_repair(s) for s in states]
+            states, saved = [p[0] for p in pairs], [p[1] for p in pairs]
+        stacked = tree(lambda *xs: jnp.stack(xs), *states)
+        ctk = tree(lambda *xs: jnp.stack(xs), *ctrls)
+        att = jnp.stack(attackers)
+        (stacked, ctk, att), shared = place_trial_batch(
+            (stacked, ctk, att), a, trial_mesh, n_rows=sim.params.n)
+        out, obs = sharded_episub_window(
+            stacked, ctk, shared, att, sim.params, ep, adv, steps,
+            trial_mesh, local, telemetry=telemetry)
+        o_states, o_ctrls = _unpack(out)
+        obs_np = tree(np.asarray, obs)
+        sts, cts = [], []
+        for j in range(s_count):
+            st = _unstack_trial(tree, o_states, j)
+            if saved is not None:
+                st = restore_repair(st, saved[j])
+            sts.append(st)
+            cts.append(_unstack_trial(tree, o_ctrls, j))
+        return sts, cts, [{k: v[j] for k, v in obs_np.items()}
+                          for j in range(s_count)]
+    if s_count == 1:
+        out, obs = run_episub_adaptive_heartbeats(
+            states[0], ctrls[0], a["conns"], a["rev"], a["out_mask"],
+            attackers[0], sim.params, ep, adv, steps, telemetry=telemetry)
+        st, ct = _unpack(out)
+        return [st], [ct], [tree(np.asarray, obs)]
+    stacked = tree(lambda *xs: jnp.stack(xs), *states)
+    ctk = tree(lambda *xs: jnp.stack(xs), *ctrls)
+    att = jnp.stack(attackers)
+
+    def one(st, ct, at):
+        return run_episub_adaptive_heartbeats(
+            st, ct, a["conns"], a["rev"], a["out_mask"], at, sim.params,
+            ep, adv, steps, batch_factor=s_count, telemetry=telemetry)
+
+    out, obs = jax.vmap(one)(stacked, ctk, att)
+    o_states, o_ctrls = _unpack(out)
+    obs_np = tree(np.asarray, obs)
+    return (
+        [tree(lambda x, j=j: x[j], o_states) for j in range(s_count)],
+        [tree(lambda x, j=j: x[j], o_ctrls) for j in range(s_count)],
+        [{k: v[j] for k, v in obs_np.items()} for j in range(s_count)],
+    )
+
+
+def _episub_publish(sim: Simulator, ctrl, ep, censor=None, attacker=None,
+                    adv=None, cross=None, partition_ms=None):
+    """_publish_schedule with the inter-message advance stepping EPISUB
+    heartbeats: Simulator.advance would re-form the GossipSub mesh
+    between publishes, silently swapping protocols mid-trial. The local
+    carry keeps Simulator.advance's drain semantics (partial heartbeats
+    accumulate across messages); sim.publish itself is protocol-neutral —
+    dissemination, censorship masking, and byte accounting all ride
+    whatever mesh_mask the protocol wrote. Returns (records, ctrl)."""
+    from ..ops.episub import run_episub_heartbeats
+    from .simulator import drain_heartbeat_carry
+
+    exp = sim.cfg
+    n = exp.topo.network_size
+    delay_ms = exp.topo.delay_seconds * 1000.0
+    pub = exp.publisher_id % n
+    a = sim.arrays
+    carry_ms = 0.0
+    for i in range(exp.topo.messages):
+        if i > 0:
+            hb_steps, carry_ms = drain_heartbeat_carry(
+                carry_ms, delay_ms, sim.params.heartbeat_ms)
+            if hb_steps > 0:
+                sim.state, ctrl = run_episub_heartbeats(
+                    sim.state, ctrl, a["conns"], a["rev"], a["out_mask"],
+                    sim.params, ep, hb_steps)
+        eff = censor
+        if cross is not None and partition_ms is not None:
+            t_now = float(np.asarray(sim.state.t_ms))
+            if partition_ms[0] <= t_now < partition_ms[1]:
+                eff = cross if censor is None else (censor | cross)
+        rec = sim.publish(pub, censor_edge=eff)
+        if censor is not None:
+            import jax.numpy as jnp
+
+            sim.state = censorship_penalty_update(
+                sim.state, a["conns"], a["rev"], attacker,
+                jnp.asarray(rec.received), sim.params, adv)
+        if exp.publisher_rotation:
+            pub = (pub + 1) % n
+    return sim.records, ctrl
+
+
+def _cohort_sha(att: np.ndarray) -> str:
+    """sha256 of the packed attacker-cohort bitmask — the per-cell
+    identity the arena artifact records so a reader (and the paired-trial
+    test) can verify both protocols faced the same node ids."""
+    import hashlib
+
+    return hashlib.sha256(
+        np.packbits(np.asarray(att, dtype=bool)).tobytes()).hexdigest()
+
+
+def _arena_recovery_ms(obs: dict, floor: float, hb_ms: float,
+                       cap_ms: float) -> float:
+    """Recovery time read off the attack-window attacker_mesh_share curve:
+    0.0 when the share never exceeds the floor (never meaningfully
+    compromised), first-return-below-floor after the peak otherwise, with
+    unrecovered windows charged `cap_ms` so a protocol that never sheds
+    the cohort cannot look cheap (run_defense_sweep's convention)."""
+    share = np.asarray(obs["attacker_mesh_share"], dtype=np.float64)
+    if share.size == 0 or share.max() <= floor:
+        return 0.0
+    peak = int(np.argmax(share))
+    rel = _first_round(share[peak:], lambda c: c <= floor)
+    return float((peak + rel) * hb_ms) if rel > 0 else cap_ms
+
+
+def _arena_obs_extras(spec_observables, obs_j) -> dict:
+    """Final-round values of the shared attack channels plus the
+    protocol's declared extra observables (ProtocolSpec.observables) —
+    the per-protocol color on each arena trial row."""
+    out: dict = {}
+    if obs_j is None:
+        return out
+    for k in ("graylisted_frac", "attacker_mesh_share") + tuple(
+            spec_observables):
+        if k in obs_j:
+            v = np.asarray(obs_j[k], dtype=np.float64)
+            if v.size:
+                out[k + "_final"] = float(v[-1])
+    return out
+
+
+def run_arena_campaign(cfg: CampaignConfig, scenarios=None, ep=None,
+                       trial_mesh=None) -> dict:
+    """Head-to-head protocol arena: GossipSub and episub race on IDENTICAL
+    inputs and the artifact scores who wins each objective per scenario.
+
+    Pairing discipline per (scenario, seed) cell — the whole point:
+
+      graph    ONE Simulator built once from the experiment seed; both
+               protocols inherit the same conns/rev/out_mask (the
+               artifact records the same graph sha256 the checkpoint
+               subsystem hashes)
+      cohort   attacker_cohort draws from (n, fraction, seed, graph)
+               only — per-cell sha256 recorded; tests/test_arena.py pins
+               cross-protocol equality
+      faults   fault_masks(seed): the same crash/partition/spike cohorts
+               thread both windows
+      traffic  the experiment's injection schedule with flood_publish
+               REQUIRED off — every publish rides mesh_mask, which is
+               exactly the surface under test (GossipSub's mesh vs
+               episub's tree), and the episub publish phase advances
+               EPISUB heartbeats between messages (_episub_publish)
+
+    "benign" is a reserved scenario name: fraction 0.0, plain heartbeat
+    windows, no adversary — the bandwidth-floor row the arena bench gate
+    reads. Attack scenarios REQUIRE the adaptive policy armed: the PR-13
+    attacker is the referee both protocols face; a static-cohort race
+    would understate both.
+
+    The arena measures INTRINSIC resilience: no repair subsystem, no
+    recovery window. recovery_time_ms is read off the attack-window
+    attacker_mesh_share curve (GossipSub recovers by score-gated
+    prune/evict, episub by graylisted re-parenting), with unrecovered
+    windows charged the full window. Returns a strict-JSON-safe dict:
+    per-trial rows, per-(scenario, protocol) aggregate rows, the win
+    matrix, and the identity block."""
+    import jax.numpy as jnp
+
+    from ..ops.episub import (EpisubParams, init_episub_ctrl,
+                              run_episub_heartbeats)
+    from ..ops.protocol import get_protocol
+    from .checkpoint import _graph_hash
+
+    cfg.validate()
+    adv0 = cfg.adversary_params()
+    if cfg.experiment.gossipsub.flood_publish:
+        raise ValueError(
+            "the arena requires flood_publish=False: flood publish routes "
+            "traffic around mesh_mask, the one surface the two protocols "
+            "differ on — the race would measure nothing")
+    fracs = [f for f in cfg.fractions if f > 0.0]
+    if not fracs:
+        raise ValueError(
+            "the arena needs an attacked fraction (> 0); the benign row "
+            "is the reserved 'benign' scenario, not a 0.0 fraction")
+    fraction = fracs[0]
+    if scenarios is None:
+        scenarios = ("benign", cfg.scenario)
+    scenarios = tuple(scenarios)
+    if any(s != "benign" for s in scenarios) and not adv0.adaptive.enabled:
+        raise ValueError(
+            "arena attack scenarios require cfg.adversary.adaptive armed: "
+            "the adaptive attacker is the referee both protocols face")
+    protos = ("gossipsub", "episub")
+    gspec, espec = get_protocol(protos[0]), get_protocol(protos[1])
+    sim = Simulator(cfg.experiment)
+    n = sim.params.n
+    hb_ms = sim.params.heartbeat_ms
+    pub = cfg.experiment.publisher_id % n
+    conns_np = np.asarray(sim.graph.conns)
+    warm_steps = int(cfg.experiment.warmup_s * 1000.0 // hb_ms)
+    steps = cfg.attack_heartbeats
+    cap_ms = float((steps + 1) * hb_ms)
+    if ep is None:
+        # the tree roots at the publisher: eager push follows the
+        # dissemination direction the traffic schedule measures
+        ep = EpisubParams(root=pub)
+    tel = cfg.telemetry if cfg.telemetry.enabled else None
+    seeds = list(cfg.seeds)
+    faulted = cfg.faults.enabled
+    t0 = time.time()
+    trials: list[dict] = []
+    cohort_shas: dict = {}
+
+    for sc in scenarios:
+        benign = sc == "benign"
+        adv = (adv0 if benign or sc == cfg.scenario
+               else replace(adv0, scenario=sc))
+        cohorts = {}
+        for s in seeds:
+            att = (np.zeros(n, dtype=bool) if benign else attacker_cohort(
+                n, fraction, seed=s, conns=conns_np, publisher=pub,
+                eclipse=adv.eclipse))
+            cohorts[s] = (att, jnp.asarray(att))
+            cohort_shas.setdefault(sc, {})[str(s)] = _cohort_sha(att)
+        fmasks = None
+        if faulted and not benign:
+            fmasks = {s: {k: jnp.asarray(v) for k, v in fault_masks(
+                n, cfg.faults, seed=s, publisher=pub).items()}
+                for s in seeds}
+        a = sim.arrays
+
+        def _finish(s, j, obs_j, spec_obs, records):
+            att, _ = cohorts[s]
+            honest = ~att
+            cov, p50, p99 = _delivery_metrics(records, honest)
+            rec_ms = (0.0 if obs_j is None else _arena_recovery_ms(
+                obs_j, cfg.mesh_recovery_share, hb_ms, cap_ms))
+            return {
+                "seed": s, "attackers": int(att.sum()),
+                "coverage": cov,
+                "bandwidth_bytes": float(
+                    np.asarray(sim.state.bytes_tx).sum()),
+                "latency_p50_ms": p50, "latency_p99_ms": p99,
+                "recovery_time_ms": rec_ms,
+                "cohort_sha256": cohort_shas[sc][str(s)],
+                **_arena_obs_extras(spec_obs, obs_j),
+            }
+
+        def _part_ctx(s):
+            # still-open partition window folded into the publish masks,
+            # same anchoring as _attacked_trials
+            if not (faulted and not benign and cfg.faults.partition):
+                return None, None
+            t_win0 = float(np.asarray(sim.state.t_ms)) - steps * hb_ms
+            pws, pwe = cfg.faults.partition_window
+            part_ms = (t_win0 + pws * hb_ms, t_win0 + pwe * hb_ms)
+            return partition_edge_mask(fmasks[s]["side"],
+                                       a["conns"]), part_ms
+
+        # ---- gossipsub side: registry-dispatched house runners
+        g_states = []
+        for s in seeds:
+            _reset_trial(sim, s)
+            sim.warmup()
+            if not benign and adv.eclipse:
+                sim.state = eclipse_setup(sim.state, a["conns"],
+                                          cohorts[s][1], pub)
+            g_states.append(sim.state)
+        if benign:
+            g_out = [gspec.run_heartbeats(
+                st, a["conns"], a["rev"], a["out_mask"], sim.params, steps)
+                for st in g_states]
+            g_obs = [None] * len(seeds)
+        else:
+            g_out, g_obs, _ = _attack_windows(
+                sim, [cohorts[s][1] for s in seeds], g_states, adv, steps,
+                trial_mesh=trial_mesh,
+                faults=cfg.faults if faulted else None,
+                fmasks=[fmasks[s] for s in seeds] if faulted else None,
+                telemetry=tel, protocol=protos[0])
+        for j, s in enumerate(seeds):
+            _reset_trial(sim, s)
+            sim.state = g_out[j]
+            cross, part_ms = _part_ctx(s)
+            censor = (None if benign
+                      else censor_mask(cohorts[s][1], a["conns"]))
+            records = _publish_schedule(
+                sim, censor=censor,
+                attacker=None if benign else cohorts[s][1],
+                adv=None if benign else adv, cross=cross,
+                partition_ms=part_ms)
+            trials.append({"scenario": sc, "protocol": protos[0],
+                           **_finish(s, j, g_obs[j], gspec.observables,
+                                     records)})
+
+        # ---- episub side: same cells, same cohorts, same fault masks
+        e_states, e_ctrls = [], []
+        for s in seeds:
+            _reset_trial(sim, s)
+            ctrl = init_episub_ctrl(n)
+            if warm_steps > 0:
+                sim.state, ctrl = run_episub_heartbeats(
+                    sim.state, ctrl, a["conns"], a["rev"], a["out_mask"],
+                    sim.params, ep, warm_steps)
+            if not benign and adv.eclipse:
+                sim.state = eclipse_setup(sim.state, a["conns"],
+                                          cohorts[s][1], pub)
+            e_states.append(sim.state)
+            e_ctrls.append(ctrl)
+        if benign:
+            e_out, e_cout, e_obs = [], [], [None] * len(seeds)
+            for st, ct in zip(e_states, e_ctrls):
+                st2, ct2 = run_episub_heartbeats(
+                    st, ct, a["conns"], a["rev"], a["out_mask"],
+                    sim.params, ep, steps)
+                e_out.append(st2)
+                e_cout.append(ct2)
+        else:
+            e_out, e_cout, e_obs = _episub_windows(
+                sim, ep, [cohorts[s][1] for s in seeds], e_states, e_ctrls,
+                adv, steps, trial_mesh=trial_mesh,
+                faults=cfg.faults if faulted else None,
+                fmasks=[fmasks[s] for s in seeds] if faulted else None,
+                telemetry=tel)
+        for j, s in enumerate(seeds):
+            _reset_trial(sim, s)
+            sim.state = e_out[j]
+            cross, part_ms = _part_ctx(s)
+            censor = (None if benign
+                      else censor_mask(cohorts[s][1], a["conns"]))
+            records, _ = _episub_publish(
+                sim, e_cout[j], ep, censor=censor,
+                attacker=None if benign else cohorts[s][1],
+                adv=None if benign else adv, cross=cross,
+                partition_ms=part_ms)
+            trials.append({"scenario": sc, "protocol": protos[1],
+                           **_finish(s, j, e_obs[j], espec.observables,
+                                     records)})
+
+    # ---- aggregates + win matrix
+    rows = []
+    for sc in scenarios:
+        for p in protos:
+            cell = [t for t in trials
+                    if t["scenario"] == sc and t["protocol"] == p]
+            rows.append({
+                "scenario": sc, "protocol": p, "trials": len(cell),
+                **{k: float(np.mean([t[k] for t in cell]))
+                   for k in ARENA_OBJECTIVES},
+            })
+    wins: dict = {}
+    win_counts = {p: 0 for p in protos}
+    ties = 0
+    for sc in scenarios:
+        by_p = {r["protocol"]: r for r in rows if r["scenario"] == sc}
+        wsc = {}
+        for k, d in ARENA_OBJECTIVES.items():
+            va, vb = by_p[protos[0]][k], by_p[protos[1]][k]
+            if ((math.isinf(va) and math.isinf(vb))
+                    or bool(np.isclose(va, vb, rtol=ARENA_REL_TOL,
+                                       atol=0.0))):
+                wsc[k] = "tie"
+                ties += 1
+                continue
+            w = protos[0] if ((va > vb) if d == "max" else (va < vb)) \
+                else protos[1]
+            wsc[k] = w
+            win_counts[w] += 1
+        wins[sc] = wsc
+
+    return sanitize_nonfinite({
+        "protocols": list(protos),
+        "scenarios": list(scenarios),
+        "network_size": n,
+        "fraction": fraction,
+        "seeds": seeds,
+        "attack_heartbeats": steps,
+        "objectives": dict(ARENA_OBJECTIVES),
+        "identity": {
+            "graph_sha256": _graph_hash(sim.graph),
+            "publisher": pub,
+            "cohort_sha256": cohort_shas,
+            "flood_publish": False,
+            "episub_root": ep.root,
+        },
+        "trials": trials,
+        "rows": rows,
+        "wins": wins,
+        "win_counts": win_counts,
+        "ties": ties,
         "wall_s": time.time() - t0,
     })

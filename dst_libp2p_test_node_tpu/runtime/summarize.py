@@ -340,3 +340,39 @@ def report_defense_sweep(sweep: dict) -> str:
         f"{sorted(front)}  beats default :  {sorted(beats)}  wall :  "
         f"{_cell(sweep.get('wall_s'), '.2f')} s")
     return "\n".join(out) + "\n"
+
+
+def report_arena(arena: dict) -> str:
+    """Text report for a run_arena_campaign artifact (runtime/campaign.py):
+    one aggregate row per (scenario, protocol) cell with the objective
+    columns, then the win matrix. Duck-typed on the artifact dict like
+    report_campaign/report_defense_sweep, so a saved JSON artifact
+    reloads straight into this (sanitized non-finite latencies render as
+    the dash)."""
+    obj = arena.get("objectives", {})
+    hdr = (f"Protocol arena :  {' vs '.join(arena['protocols'])}  Peers :  "
+           f"{arena['network_size']}  fraction :  {arena['fraction']:g}  "
+           f"objectives :  " + "  ".join(f"{k}({v})"
+                                         for k, v in obj.items()))
+    cols = ("scenario \t protocol \t coverage \t bandwidth_B \t p50_ms "
+            "\t p99_ms \t recover_ms \t trials")
+    out = [hdr, cols]
+    for r in arena["rows"]:
+        out.append(" \t ".join([
+            r["scenario"], r["protocol"],
+            _cell(r["coverage"], ".4f"),
+            _cell(r["bandwidth_bytes"], ".0f"),
+            _cell(r["latency_p50_ms"], ".1f"),
+            _cell(r["latency_p99_ms"], ".1f"),
+            _mcell(r["recovery_time_ms"], ".1f"),
+            str(r["trials"]),
+        ]))
+    for sc, wsc in arena.get("wins", {}).items():
+        out.append(f"wins[{sc}] :  " + "  ".join(
+            f"{k}={w}" for k, w in wsc.items()))
+    wc = arena.get("win_counts", {})
+    out.append(
+        "Win counts :  " + "  ".join(f"{p}={c}" for p, c in wc.items())
+        + f"  ties :  {arena.get('ties', 0)}  wall :  "
+        f"{_cell(arena.get('wall_s'), '.2f')} s")
+    return "\n".join(out) + "\n"

@@ -1,0 +1,181 @@
+"""Protocol arena tests (runtime/campaign.run_arena_campaign, ISSUE 19
+tentpole layer 3).
+
+Two layers of contract:
+
+  - degenerate configs are rejected up front: flood_publish on (routes
+    traffic around mesh_mask, the one surface the protocols differ on),
+    no attacked fraction, disarmed adaptive policy on an attack scenario.
+  - the pinned slow test drives the arena CLI end-to-end and asserts the
+    artifact's pairing discipline (same graph sha, same per-cell cohort
+    sha on BOTH protocols' trial rows) plus the measured protocol trade
+    the arena exists to surface: the episub tree undercuts GossipSub's
+    benign bandwidth, and GossipSub's score-gated mesh sheds the armed
+    attacker faster than episub's graylist re-parenting.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from dst_libp2p_test_node_tpu.config.topology import TopoParams
+from dst_libp2p_test_node_tpu.ops.adversary import (
+    AdaptivePolicy,
+    AdversaryParams,
+)
+from dst_libp2p_test_node_tpu.runtime.campaign import (
+    ARENA_OBJECTIVES,
+    CampaignConfig,
+    _cohort_sha,
+    attack_gossipsub,
+    run_arena_campaign,
+)
+from dst_libp2p_test_node_tpu.runtime.simulator import ExperimentConfig
+
+N = 48
+SEEDS = (0, 1)
+SCENARIO = "sybil_graft_flood"
+
+
+def _arena_cfg(**over):
+    kw = dict(
+        scenario=SCENARIO,
+        fractions=(0.25,),
+        seeds=SEEDS,
+        experiment=ExperimentConfig(
+            topo=TopoParams(network_size=N, anchor_stages=3,
+                            msg_size_bytes=2000, messages=2,
+                            delay_seconds=0.5),
+            connect_to=8,
+            gossipsub=attack_gossipsub(flood_publish=False),
+            publisher_id=4,
+            warmup_s=8.0,
+            seed=0),
+        adversary=AdversaryParams(
+            scenario=SCENARIO, adaptive=AdaptivePolicy(enabled=True)),
+        attack_heartbeats=6)
+    kw.update(over)
+    return CampaignConfig(**kw)
+
+
+# ---------------------------------------------------------------------------
+# degenerate configs fail fast, before any window compiles
+
+
+def test_arena_rejects_flood_publish():
+    cfg = _arena_cfg(experiment=ExperimentConfig(
+        topo=TopoParams(network_size=N, anchor_stages=3,
+                        msg_size_bytes=2000, messages=2,
+                        delay_seconds=0.5),
+        connect_to=8, gossipsub=attack_gossipsub(flood_publish=True),
+        publisher_id=4, warmup_s=8.0, seed=0))
+    with pytest.raises(ValueError, match="flood_publish"):
+        run_arena_campaign(cfg)
+
+
+def test_arena_rejects_zero_fraction():
+    with pytest.raises(ValueError, match="attacked fraction"):
+        run_arena_campaign(_arena_cfg(fractions=(0.0,)))
+
+
+def test_arena_rejects_disarmed_adaptive():
+    cfg = _arena_cfg(adversary=AdversaryParams(scenario=SCENARIO))
+    with pytest.raises(ValueError, match="adaptive"):
+        run_arena_campaign(cfg)
+
+
+def test_arena_cli_rejects_non_adaptive_scenarios():
+    from dst_libp2p_test_node_tpu.cli import cmd_arena
+
+    with pytest.raises(SystemExit):
+        cmd_arena(["--scenarios", "benign,not_a_scenario"])
+    with pytest.raises(SystemExit):
+        cmd_arena(["--scenarios", "benign"])  # no attack row
+    with pytest.raises(SystemExit):
+        cmd_arena(["--fraction", "1.5"])
+
+
+# ---------------------------------------------------------------------------
+# the pinned head-to-head: CLI -> strict-JSON artifact -> measured trade
+
+
+@pytest.mark.slow
+def test_arena_cli_artifact_pairing_and_pinned_trade(tmp_path, capsys):
+    from dst_libp2p_test_node_tpu.cli import cmd_arena
+
+    out = tmp_path / "arena.json"
+    rc = cmd_arena([
+        "-n", str(N), "--seeds", ",".join(str(s) for s in SEEDS),
+        "--attack-heartbeats", "6", "--warmup-s", "8.0",
+        "--messages", "2", "--delay-s", "0.5",
+        "--scenarios", f"benign,{SCENARIO}",
+        "--json", str(out)])
+    assert rc == 0
+    rendered = capsys.readouterr().out
+    art = json.loads(out.read_text())
+
+    # strict JSON: a second round-trip with allow_nan=False must agree
+    assert json.loads(json.dumps(art, allow_nan=False)) == art
+    assert art["protocols"] == ["gossipsub", "episub"]
+    assert art["scenarios"] == ["benign", SCENARIO]
+    assert art["objectives"] == ARENA_OBJECTIVES
+    for p in art["protocols"]:
+        assert p in rendered  # report_arena printed the race
+
+    # pairing discipline: ONE graph, and per (scenario, seed) cell the
+    # SAME attacker cohort on both protocols' trial rows
+    ident = art["identity"]
+    assert len(ident["graph_sha256"]) == 64
+    assert ident["flood_publish"] is False
+    assert ident["episub_root"] == ident["publisher"]
+    rows = {(t["scenario"], t["protocol"], t["seed"]): t
+            for t in art["trials"]}
+    assert len(rows) == len(art["trials"]) == (
+        len(art["scenarios"]) * len(art["protocols"]) * len(SEEDS))
+    zero_sha = _cohort_sha(np.zeros(N, dtype=bool))
+    for sc in art["scenarios"]:
+        for s in SEEDS:
+            g = rows[(sc, "gossipsub", s)]
+            e = rows[(sc, "episub", s)]
+            assert g["cohort_sha256"] == e["cohort_sha256"] \
+                == ident["cohort_sha256"][sc][str(s)]
+            if sc == "benign":
+                assert g["attackers"] == 0
+                assert g["cohort_sha256"] == zero_sha
+            else:
+                assert g["attackers"] > 0
+                assert g["cohort_sha256"] != zero_sha
+    # the cohort draw actually varies by seed on the attack row
+    atk_shas = {rows[(SCENARIO, "gossipsub", s)]["cohort_sha256"]
+                for s in SEEDS}
+    assert len(atk_shas) == len(SEEDS)
+
+    # win matrix accounting: every (scenario, objective) cell is scored
+    # exactly once as a win or a tie
+    cells = 0
+    for sc in art["scenarios"]:
+        for k, w in art["wins"][sc].items():
+            assert k in ARENA_OBJECTIVES
+            assert w in ("tie", *art["protocols"])
+            cells += 1
+    assert cells == len(art["scenarios"]) * len(ARENA_OBJECTIVES)
+    assert sum(art["win_counts"].values()) + art["ties"] == cells
+
+    # the measured trade (the artifact's reason to exist): the tree's
+    # eager push undercuts the mesh's duplicate-heavy benign bandwidth,
+    # while GossipSub's score-gated prune/evict sheds the armed cohort
+    # faster than episub's graylist re-parenting
+    agg = {(r["scenario"], r["protocol"]): r for r in art["rows"]}
+    bw_g = agg[("benign", "gossipsub")]["bandwidth_bytes"]
+    bw_e = agg[("benign", "episub")]["bandwidth_bytes"]
+    assert bw_e < bw_g, (
+        f"benign bandwidth episub {bw_e:.0f} >= gossipsub {bw_g:.0f}: "
+        "the Topiary bandwidth trade is gone")
+    rec_g = agg[(SCENARIO, "gossipsub")]["recovery_time_ms"]
+    rec_e = agg[(SCENARIO, "episub")]["recovery_time_ms"]
+    assert rec_g < rec_e, (
+        f"attacked recovery gossipsub {rec_g:.0f}ms >= episub "
+        f"{rec_e:.0f}ms: the resilience trade flipped")
+    for proto in art["protocols"]:
+        assert agg[("benign", proto)]["coverage"] >= 0.95

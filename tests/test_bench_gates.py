@@ -212,11 +212,12 @@ def test_bench_tripwire_is_keyed_per_config(tmp_path):
     # the resident-service probe the -svc suffix, the batched-dispatch
     # flip the dispatch-mode suffix (ISSUE 14), the adaptive-attacker
     # probe the -adaptive suffix (ISSUE 15), and the mega-round scan flip
-    # the -fused suffix (ISSUE 16) — each opens a FRESH bucket, so the
+    # the -fused suffix (ISSUE 16), and the protocol-arena probe the
+    # -arena suffix (ISSUE 19) — each opens a FRESH bucket, so the
     # first run of a new shape compares against nothing instead of
     # tripping a false regression against committed rows of the old shape
     assert bench.BENCH_CONFIG == \
-        "n100000-r300-m3-exact-dht-svc-batched-adaptive-fused"
+        "n100000-r300-m3-exact-dht-svc-batched-adaptive-fused-arena"
     assert bench.best_committed_peer_rounds(
         config_key=bench.BENCH_CONFIG) is None
     assert bench._config_key_of(

@@ -122,7 +122,8 @@ def test_certificate_is_strict_json(tmp_path):
     present, clean verdict for a conformant scenario."""
     cert = conformance_certificate(
         scenarios=("sybil_graft_flood",), seeds=(0,), include_adaptive=False,
-        include_faults=False, include_churn=False, include_gossip=False)
+        include_faults=False, include_churn=False, include_gossip=False,
+        include_og=False)
     path = write_certificate(cert, tmp_path / "conformance.json")
     loaded = json.loads(path.read_text(),
                         parse_constant=lambda c: pytest.fail(f"non-finite {c}"))

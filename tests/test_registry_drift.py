@@ -102,6 +102,29 @@ def test_allowlist_entries_are_live_and_necessary():
     assert all(ALLOWLIST.values()), "every allowlist entry needs a reason"
 
 
+def test_arena_subsystem_is_registered_not_allowlisted():
+    """ISSUE 19: ops/episub.py carries the jit idiom and must be covered
+    by a real contract (episub/heartbeat_step), never waived; the arena
+    window rides runtime/campaign via protocol/arena_window."""
+    names = {c.name for c in default_contracts()}
+    assert "episub/heartbeat_step" in names
+    assert "protocol/arena_window" in names
+    assert "ops/episub" in _jitted_modules()
+    assert "ops/episub" in _covered_modules()
+    assert "ops/episub" not in ALLOWLIST
+
+
+def test_protocol_registry_is_jit_free():
+    """ops/protocol.py is pure dispatch — the ProtocolSpec fields ARE the
+    already-audited runner objects, so the registry itself must never
+    grow a compiled surface (that would dodge the drift gate: protocol/
+    is outside the ops//runtime/ scan roots)."""
+    src = (PKG / "ops" / "protocol.py").read_text()
+    assert not _JIT_RE.search(src), (
+        "ops/protocol.py gained a jit idiom — register a contract for it "
+        "and extend _jitted_modules' scan if dispatch now compiles")
+
+
 def test_jit_idiom_regex_matches_repo_convention():
     # the dominant idiom is @partial(jax.jit, static_argnames=...); if the
     # repo ever migrates off it, the scan regex must follow

@@ -42,7 +42,8 @@ from dst_libp2p_test_node_tpu.parallel.sharding import make_peer_mesh
 
 REPO = Path(__file__).resolve().parents[1]
 
-WINDOW_NAMES = ("adversary/adaptive_window", "faults/churn_window")
+WINDOW_NAMES = ("adversary/adaptive_window", "faults/churn_window",
+                "protocol/arena_window")
 
 
 def _rules_of(violations):
@@ -94,7 +95,8 @@ def test_nested_window_partitions_and_declared_collectives(window_audit):
     by_name = {c.name: c for c in contracts}
     for name in ("campaign/attack_window_nested",
                  "campaign/faulted_window_nested",
-                 "campaign/dht_attack_window"):
+                 "campaign/dht_attack_window",
+                 "protocol/arena_window"):
         f = facts[name]
         assert f["num_partitions"] == jax.device_count(), (name, f)
         assert set(f["collectives"]) <= set(by_name[name].collectives)
