@@ -100,9 +100,14 @@ FUSED_ROUNDS = os.environ.get("BENCH_FUSED", "1") == "1"
 # way: a run that also races GossipSub against the episub tree backend
 # (runtime/campaign.run_arena_campaign) opens a fresh tripwire bucket
 # instead of comparing against pre-arena artifacts
+# the "-dcn" suffix keys the multi-host campaign probe (ISSUE 20,
+# runtime/campaign.run_campaign(dcn=...)): a run that also launches the
+# two-process gloo campaign and times its merged throughput against the
+# single-process 8-device grid opens a fresh tripwire bucket instead of
+# comparing against pre-DCN artifacts
 BENCH_CONFIG = (f"n{N_PEERS}-r{HB_ROUNDS}-m{MESSAGES}-{DELIVERY_MODE}"
                 f"-dht-svc-{SERVICE_DISPATCH_MODE}-adaptive"
-                + ("-fused" if FUSED_ROUNDS else "") + "-arena")
+                + ("-fused" if FUSED_ROUNDS else "") + "-arena-dcn")
 
 
 def attribution_split(
@@ -832,6 +837,84 @@ def main() -> None:
         "smoke shape — one scan dispatch per group should beat one "
         "dispatch per request")
 
+    # multi-host DCN campaign probe (ISSUE 20): launch the two-process
+    # engine end-to-end — 2 gloo ranks x 4 virtual CPU devices vs the
+    # single-process 8-device grid on the SAME total work — min-of-3
+    # subprocess invocations against one shared compilation cache, each
+    # with an untimed warm-up sweep, so the throughput gated here is the
+    # engine's steady state (scripts/dcn_campaign.py). Pre-emit gates:
+    # every invocation must merge BIT-IDENTICAL observables (a fast run
+    # with wrong numbers is a broken engine, not a fast one), the
+    # core-normalized scaling efficiency must clear 0.6 (normalization:
+    # a 1-core smoke host physically serializes the two ranks — the gate
+    # judges the engine against what the host can deliver, same meaning
+    # on a many-core runner), and the attacked trials must keep the
+    # honest-coverage floor (throughput with a collapsed sim is not
+    # throughput).
+    import subprocess as _sp
+    import sys
+    import tempfile as _tf
+
+    _dcn_script = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "scripts", "dcn_campaign.py")
+    dcn_best = None
+    with _tf.TemporaryDirectory(prefix="bench_dcn_") as _dcn_tmp:
+        _dcn_cache = os.path.join(_dcn_tmp, "cache")
+        # a rank killed by distributed-runtime infrastructure (gloo pair
+        # teardown, coordination-service heartbeat starvation on an
+        # oversubscribed host) is an environment flake, not a perf or
+        # correctness signal: grant the 3 measured reps a small retry
+        # budget for THAT class only. A rep that runs to completion is
+        # never retried — its gates (bit-identity, coverage) stay hard
+        _dcn_flake_budget = 2
+        rep = 0
+        attempt = 0
+        while rep < 3:
+            _wd = os.path.join(_dcn_tmp, f"rep{rep}.{attempt}")
+            _res_path = os.path.join(_wd, "result.json")
+            os.makedirs(_wd)
+            _proc = _sp.run(
+                [sys.executable, _dcn_script, "--out", _res_path,
+                 "--workdir", os.path.join(_wd, "work"),
+                 "--cache-dir", _dcn_cache, "--warmup",
+                 "--seeds", "8", "--heartbeats", "12"],
+                capture_output=True, text=True, timeout=1200)
+            if (_proc.returncode != 0 and _dcn_flake_budget > 0
+                    and not os.path.exists(_res_path)):
+                _dcn_flake_budget -= 1
+                attempt += 1
+                print(f"bench: dcn probe rep {rep} hit an infra flake "
+                      f"(rc={_proc.returncode}), retrying "
+                      f"({_dcn_flake_budget} retries left)",
+                      file=sys.stderr, flush=True)
+                continue
+            assert _proc.returncode == 0, (
+                f"dcn probe rep {rep} failed "
+                f"(rc={_proc.returncode}):\n{_proc.stdout[-2000:]}")
+            rep += 1
+            attempt = 0
+            with open(_res_path) as _f:
+                _rep_res = json.load(_f)
+            assert _rep_res["bit_identical"], (
+                f"dcn probe rep {rep}: two-process merged observables "
+                "differ from the single-process grid — the DCN boundary "
+                "changed numerics")
+            assert _rep_res["honest_coverage_min"] >= 0.9, (
+                f"dcn probe rep {rep}: honest coverage floor broken "
+                f"({_rep_res['honest_coverage_min']:.3f} < 0.9) — the "
+                "probe timed a collapsed sim")
+            if (dcn_best is None or _rep_res["dcn_trials_per_s"]
+                    > dcn_best["dcn_trials_per_s"]):
+                dcn_best = _rep_res
+    dcn_trials_per_s = dcn_best["dcn_trials_per_s"]
+    assert dcn_best["scaling_efficiency_normalized"] >= 0.6, (
+        f"dcn scaling efficiency {dcn_best['scaling_efficiency']:.3f} "
+        f"(normalized {dcn_best['scaling_efficiency_normalized']:.3f} on "
+        f"{dcn_best['host_cores']} cores) below the 0.6 floor: the "
+        "two-process engine is losing more than 40% of the throughput "
+        "this host can physically deliver to orchestration overhead")
+
     rounds = MESSAGES * per_burst
     value = N_PEERS * rounds / wall
     # coverage and percentiles over ALL timed messages, not the last one's
@@ -1057,6 +1140,32 @@ def main() -> None:
                 "batch_factor": round(
                     svc_rep["dispatched"]
                     / max(svc_rep["device_dispatches"], 1), 3),
+            },
+            # multi-host DCN campaign probe: two gloo processes x 4
+            # virtual CPU devices vs the single-process 8-device grid on
+            # the same total work, min-of-3 + warm-up (steady state); the
+            # pre-emit gates above pinned bit-identity, the normalized
+            # scaling floor and the honest-coverage floor before this
+            # block could be emitted
+            "dcn_trials_per_s": round(dcn_trials_per_s, 3),
+            "dcn": {
+                "nproc": dcn_best["nproc"],
+                "devs_per_proc": dcn_best["devs_per_proc"],
+                "network_size": dcn_best["network_size"],
+                "trials": dcn_best["trials"],
+                "host_cores": dcn_best["host_cores"],
+                "ideal_scaling": dcn_best["ideal_scaling"],
+                "dcn_wall_s": round(dcn_best["dcn_wall_s"], 3),
+                "single_wall_s": round(dcn_best["single_wall_s"], 3),
+                "single_trials_per_s": round(
+                    dcn_best["single_trials_per_s"], 3),
+                "scaling_efficiency": round(
+                    dcn_best["scaling_efficiency"], 4),
+                "scaling_efficiency_normalized": round(
+                    dcn_best["scaling_efficiency_normalized"], 4),
+                "bit_identical": dcn_best["bit_identical"],
+                "honest_coverage_min": round(
+                    dcn_best["honest_coverage_min"], 4),
             },
             "p50_ms": float(np.percentile(delays[ok], 50)),
             "p99_ms": float(np.percentile(delays[ok], 99)),

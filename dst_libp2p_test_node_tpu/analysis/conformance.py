@@ -649,6 +649,74 @@ def certificate_entry(scenario, divergences, waivers, **meta):
 
 
 # ---------------------------------------------------------------------------
+# parameter-grid fuzzing: random SimParams through the same differential
+
+
+def sample_sim_params(rng, capacity):
+    """One random parameter grid for the differential, as a kwargs dict.
+
+    The degree lattice respects the v1.1 invariants the router assumes:
+    0 < d_low <= d <= d_high <= capacity, d_score <= d, and
+    d_out < d_low with d_out <= d/2 (the outbound-quota constraints the
+    reference enforces at config time). Score knobs stay in the armed
+    regime — negative penalty weight, ordered thresholds
+    gossip >= publish >= graylist — so every score-gated branch remains a
+    live branch on both sides of the differential."""
+    d_low = int(rng.integers(1, min(6, capacity) + 1))
+    d = int(rng.integers(d_low, min(capacity, d_low + 6) + 1))
+    d_high = int(rng.integers(d, capacity + 1))
+    d_score = int(rng.integers(1, d + 1))
+    d_out = int(rng.integers(1, max(1, min(d_low - 1, d // 2)) + 1))
+    d_lazy = int(rng.integers(1, capacity + 1))
+    gossip_threshold = round(float(rng.uniform(-20.0, -2.0)), 3)
+    publish_threshold = round(
+        gossip_threshold - float(rng.uniform(1.0, 20.0)), 3)
+    graylist_threshold = round(
+        publish_threshold - float(rng.uniform(1.0, 40.0)), 3)
+    return dict(
+        d=d, d_low=d_low, d_high=d_high, d_score=d_score, d_out=d_out,
+        d_lazy=d_lazy,
+        gossip_factor=round(float(rng.uniform(0.05, 0.5)), 3),
+        slow_weight=round(float(rng.uniform(-20.0, -1.0)), 3),
+        slow_decay=round(float(rng.uniform(0.1, 0.95)), 3),
+        gossip_threshold=gossip_threshold,
+        publish_threshold=publish_threshold,
+        graylist_threshold=graylist_threshold,
+    )
+
+
+def run_fuzz_differential(n_samples, n=48, connect_to=8, seed=0, steps=8,
+                          warm_steps=4, fuzz_seed=0):
+    """`n_samples` random parameter grids through the scenario differential.
+
+    Returns [(entry_name, knobs, divergences)] — one differential instance
+    per sample, cycling through the attack canon so every scenario's
+    branches meet fuzzed degree bounds / gossip factor / score weights, not
+    just the ARMED point the fixed certificate pins. Deterministic in
+    fuzz_seed (np.random.default_rng stream; graph/state/cohort reseed from
+    `seed` exactly as the fixed entries do). Each distinct grid is a fresh
+    jit static arg — expect one compile per sample."""
+    from ..ops.adversary import SCENARIOS
+    from ..ops.graph import build_connection_graph
+    from ..ops.state import SimParams
+
+    rng = np.random.default_rng(fuzz_seed)
+    # capacity is a property of the topology, not a fuzzable knob: the
+    # fixture will rebuild this exact graph (same n/connect_to/seed)
+    g = build_connection_graph(n, connect_to, seed=seed)
+    out = []
+    for k in range(n_samples):
+        knobs = sample_sim_params(rng, g.capacity)
+        scenario = SCENARIOS[k % len(SCENARIOS)]
+        params = SimParams(n=n, capacity=g.capacity, **knobs)
+        divs = run_scenario_differential(
+            scenario, n=n, connect_to=connect_to, seed=seed, steps=steps,
+            warm_steps=warm_steps, params=params)
+        out.append((f"fuzz:{scenario}:{k}", knobs, divs))
+    return out
+
+
+# ---------------------------------------------------------------------------
 # the certificate
 
 
@@ -656,11 +724,13 @@ def conformance_certificate(scenarios=None, n=48, connect_to=8, seeds=(0,),
                             steps=8, warm_steps=4, waivers_path=None,
                             include_adaptive=True, include_faults=True,
                             include_churn=True, include_gossip=True,
-                            include_og=True):
+                            include_og=True, fuzz=0, fuzz_seed=0):
     """Run the full conformance fuzz sweep and build the certificate dict:
     every attack scenario x every seed through the per-round differential,
     plus the adaptive-controller, fault-family, churn, and cross-fragment
-    entries. Strict-JSON-safe after sanitize_nonfinite (write_certificate)."""
+    entries. fuzz>0 appends that many random-parameter-grid entries
+    (run_fuzz_differential). Strict-JSON-safe after sanitize_nonfinite
+    (write_certificate)."""
     from ..ops.adversary import SCENARIOS
 
     if scenarios is None:
@@ -712,6 +782,13 @@ def conformance_certificate(scenarios=None, n=48, connect_to=8, seeds=(0,),
         divs = cross_fragment_check(seed=seeds[0])
         entries.append(certificate_entry("gossip_fragments", divs, waivers,
                                          seeds=[seeds[0]], n=64, steps=1))
+    if fuzz:
+        for name, knobs, divs in run_fuzz_differential(
+                fuzz, n=n, connect_to=connect_to, seed=seeds[0],
+                steps=steps, warm_steps=warm_steps, fuzz_seed=fuzz_seed):
+            entries.append(certificate_entry(
+                name, divs, waivers, seeds=[seeds[0]], n=n, steps=steps,
+                params=knobs, fuzz_seed=fuzz_seed))
     sim_bugs = sum(e["sim_bugs"] for e in entries)
     return {
         "version": 1,

@@ -99,6 +99,21 @@ class EntrypointContract:
     # XLA memory_analysis) at the canonical audit shape (GA-S004);
     # None = unbudgeted
     hbm_budget_bytes: int | None = None
+    # --- DCN-axis scoping (GA-S006) ---
+    # dcn_block_devices: devices per DCN block (= per process) on the
+    # contract's canonical 3-level audit mesh. When set, the auditor parses
+    # every collective's replica_groups and splits its per-device bytes by
+    # scope: a group whose partition ids span >= 2 blocks moves data across
+    # the DCN boundary. None (the default) leaves the rule off — right for
+    # every contract traced on a 1- or 2-level mesh.
+    dcn_block_devices: int | None = None
+    # ceiling on the summed per-device bytes of CROSS-DCN collective
+    # outputs (GA-S006). The design target for the dcn x trials x peers
+    # grid is literally zero: trials are embarrassingly parallel across
+    # processes and every peer-axis collective must stay inside one ICI
+    # block, so any cross-DCN byte means the partitioner stopped seeing
+    # the placement the grid was designed around.
+    dcn_collective_bytes_budget: int = 0
     # pinned waivers: ((rule_id, rationale), ...). A finding whose rule is
     # waived here is recorded in the report's "waived" block with its
     # rationale instead of failing the gate — the docs/LINT_RULES.md waiver

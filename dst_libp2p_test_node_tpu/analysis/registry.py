@@ -488,6 +488,104 @@ def attack_rung_spec(n: int, *, steps: int = 20, connect_to: int = 10,
                     local_trials=local_trials))
 
 
+def _dcn_audit_shape() -> tuple[int, int]:
+    """(dcn blocks, per-block trial groups) for the 3-level audit mesh,
+    degrading with the host's device count the way audit_trial_groups
+    does: 2x2x2 under the CI 8-device grid, 2x2x1 under the 4-device lint
+    gate, 2x1x1 at two devices, 1x1x1 on a single device."""
+    import jax
+
+    nd = len(jax.devices())
+    dcn = 2 if nd >= 2 else 1
+    groups = 2 if nd // dcn >= 2 else 1
+    return dcn, groups
+
+
+def _dcn_block_devices() -> int:
+    """Per-process device count on the canonical 3-level audit mesh — the
+    GA-S006 blocking the contract declares (process-major device order
+    makes partition_id // block the dcn index)."""
+    import jax
+
+    dcn, _groups = _dcn_audit_shape()
+    return len(jax.devices()) // dcn
+
+
+def _dcn_attack_window_spec() -> TraceSpec:
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.adversary import AdversaryParams, attacker_cohort
+    from ..ops.state import strip_repair
+    from ..parallel.sharding import make_dcn_mesh
+    from ..runtime.campaign import sharded_attack_window
+
+    # the three-level placement contract: the SAME nested window program the
+    # campaign dispatches per process, traced single-process on the full
+    # dcn x trials x peers mesh so GA-S006 can statically prove no
+    # peer-axis collective ever crosses a dcn block boundary
+    g, params, state, a, _ = _single_topic()
+    state, _saved = strip_repair(state)
+    dcn, groups = _dcn_audit_shape()
+    mesh = make_dcn_mesh(dcn=dcn, trial_groups=groups)
+    local = 2
+    trials = dcn * groups * local
+    stacked = jax.tree_util.tree_map(
+        lambda x: jnp.stack([jnp.asarray(x)] * trials), state)
+    att = jnp.stack([
+        jnp.asarray(attacker_cohort(params.n, 0.25, seed=s))
+        for s in range(trials)])
+    shared = {k: a[k] for k in ("conns", "rev", "out_mask")}
+    return TraceSpec(
+        fn=sharded_attack_window,
+        args=(stacked, shared, att),
+        kwargs=dict(params=params, adv=AdversaryParams(), steps=3,
+                    trial_mesh=mesh, local_trials=local))
+
+
+def arena_rung_spec(n: int, *, steps: int = 20, connect_to: int = 10,
+                    local_trials: int = 2,
+                    trial_groups: int | None = None) -> TraceSpec:
+    """The arena ladder program at an arbitrary peer count: the sharded
+    episub attack window (protocol/arena_window) on the config-8 grid
+    shape, with the EpisubCtrl carry stacked alongside SimState. The rung
+    predictor lowers THIS spec the same way it lowers attack_rung_spec, so
+    the per-leaf power-law fits learn the `[...].hops/parent/reparents`
+    leaves and the ROADMAP's arena-at-1M question gets the same
+    compile-time fits / does-not-fit answer as the GossipSub window."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.adversary import (AdaptivePolicy, AdversaryParams,
+                                 attacker_cohort)
+    from ..ops.episub import EpisubParams, init_episub_ctrl
+    from ..ops.state import strip_repair
+    from ..parallel.sharding import make_trial_mesh
+    from ..runtime.campaign import sharded_episub_window
+
+    g, params, state, a, _ = _single_topic(n=n, connect_to=connect_to,
+                                           **_ARMED)
+    state, _saved = strip_repair(state)
+    groups = 2 if trial_groups is None else trial_groups
+    mesh = make_trial_mesh(groups)
+    trials = groups * local_trials
+    stack = lambda x: jnp.stack([jnp.asarray(x)] * trials)  # noqa: E731
+    stacked = jax.tree_util.tree_map(stack, state)
+    ctrls = jax.tree_util.tree_map(stack, init_episub_ctrl(params.n))
+    att = jnp.stack([
+        jnp.asarray(attacker_cohort(params.n, 0.1, seed=s))
+        for s in range(trials)])
+    shared = {k: a[k] for k in ("conns", "rev", "out_mask")}
+    adv = AdversaryParams(scenario="sybil_graft_flood",
+                          adaptive=AdaptivePolicy(enabled=True))
+    return TraceSpec(
+        fn=sharded_episub_window,
+        args=(stacked, ctrls, shared, att),
+        kwargs=dict(params=params, ep=EpisubParams(root=3), adv=adv,
+                    steps=steps, trial_mesh=mesh,
+                    local_trials=local_trials))
+
+
 def _telemetry_spec() -> TraceSpec:
     from ..ops.telemetry import TelemetryParams, run_recorded_heartbeats
 
@@ -925,6 +1023,35 @@ def default_contracts() -> list[EntrypointContract]:
                   "budgets (GA-S002..4) — a reduce-scatter or all-to-all "
                   "appearing here means the partitioner stopped seeing "
                   "the layout the grid was designed around"),
+        EntrypointContract(
+            name="campaign/attack_window_dcn",
+            build=_dcn_attack_window_spec,
+            expected_conds=None,
+            feedback=[(_first_out, _state_arg_of)],
+            # explicit in/out_shardings force a fresh jit closure per
+            # window: one compile per call by construction
+            retrace_budget=1,
+            collectives=frozenset(
+                {"all-gather", "all-reduce", "collective-permute"}),
+            collective_bytes_budget=64 * 1024,
+            hbm_budget_bytes=2 * 1024 * 1024,
+            # GA-S006: on the 3-level mesh a dcn block is one process's
+            # devices — device_count / dcn with make_dcn_mesh's defaults —
+            # and the cross-DCN byte budget is literally zero: trials are
+            # embarrassingly parallel across processes, every peer-axis
+            # collective must stay inside one ICI block
+            dcn_block_devices=_dcn_block_devices(),
+            dcn_collective_bytes_budget=0,
+            notes="the multi-host placement contract (ISSUE 20): the same "
+                  "nested attack window traced on the three-level "
+                  "dcn x trials x peers mesh, stacked trials split "
+                  "(dcn, trials)-major and peer rows over each block's "
+                  "submesh. GA-S006 parses every collective's replica "
+                  "groups and proves zero bytes cross the dcn axis — the "
+                  "static license for run_campaign(dcn=...) to execute "
+                  "per-process on local submeshes (supervisor retries, "
+                  "checkpoints, recovery all process-local) without "
+                  "losing anything the global formulation would compute"),
         EntrypointContract(
             name="campaign/dht_attack_window",
             build=_dht_attack_window_spec,

@@ -32,6 +32,12 @@ Rules (GA-S family; declarations live on EntrypointContract):
   GA-S003  summed per-device collective bytes over ``collective_bytes_budget``
   GA-S004  per-device peak memory over ``hbm_budget_bytes``
   GA-S005  declared donation not aliased in the compiled output
+  GA-S006  collective bytes crossing the DCN axis over
+           ``dcn_collective_bytes_budget`` — replica groups parsed from the
+           compiled HLO (explicit and iota forms) and classified against
+           the process-major ``dcn_block_devices`` blocking, so "zero
+           peer-axis bytes ever cross a host boundary" is a statically
+           gated property of the 3-level dcn x trials x peers grid
 
 A finding whose rule is pinned in ``contract.waivers`` lands in the
 report's "waived" block with its rationale instead of failing the gate
@@ -117,6 +123,82 @@ def collect_collectives(hlo_text: str) -> dict[str, dict]:
 def _num_partitions(hlo_text: str) -> int:
     m = _PARTITIONS_RE.search(hlo_text)
     return int(m.group(1)) if m else 1
+
+
+# replica_groups in compiled HLO: explicit `{{0,1},{2,3}}`, empty `{}`
+# (one group over everything), or the iota form `[G,S]<=[dims]` with an
+# optional transpose `T(perm)` (XLA's compact encoding for regular grids).
+# collective-permute carries source_target_pairs instead — each {src,dst}
+# pair is its own two-member "group" for scope classification.
+_REPLICA_GROUPS_RE = re.compile(
+    r"(?:replica_groups|source_target_pairs)="
+    r"(\{\{[0-9,{}\s]*\}\}|\{\}|"
+    r"\[[0-9,]+\]<=\[[0-9,]+\](?:T\([0-9,]+\))?)")
+
+
+def _parse_replica_groups(instr_text: str) -> list[list[int]] | None:
+    """Partition-id groups of one collective instruction, or None when the
+    instruction carries no replica_groups attribute. The empty `{}` form
+    returns [] — caller-side that means "one group spanning everything"."""
+    m = _REPLICA_GROUPS_RE.search(instr_text)
+    if not m:
+        return None
+    tok = m.group(1)
+    if tok == "{}":
+        return []
+    if tok.startswith("{"):
+        groups = []
+        for grp in re.findall(r"\{([0-9,\s]*)\}", tok):
+            ids = [int(x) for x in grp.replace(" ", "").split(",") if x]
+            if ids:
+                groups.append(ids)
+        return groups
+    import numpy as np
+
+    im = re.match(r"\[([0-9,]+)\]<=\[([0-9,]+)\](?:T\(([0-9,]+)\))?", tok)
+    out_dims = [int(x) for x in im.group(1).split(",")]
+    reshape_dims = [int(x) for x in im.group(2).split(",")]
+    ids = np.arange(math.prod(reshape_dims)).reshape(reshape_dims)
+    if im.group(3):
+        ids = ids.transpose([int(x) for x in im.group(3).split(",")])
+    ids = ids.reshape(out_dims)
+    return [[int(i) for i in row] for row in ids]
+
+
+def collect_collective_scopes(hlo_text: str, block_devices: int,
+                              num_partitions: int | None = None) -> dict:
+    """Split per-device collective bytes by DCN scope (the GA-S006 fact).
+
+    `block_devices` is the per-process device count on the 3-level
+    dcn x trials x peers mesh; make_dcn_mesh orders devices process-major,
+    so partition id // block_devices IS the DCN block index. A collective
+    whose replica group spans >= 2 blocks moves bytes across the DCN
+    boundary; everything else stays on one process's ICI submesh. A
+    collective with no / empty replica_groups is conservatively cross-DCN
+    whenever the program has more partitions than one block holds."""
+    if num_partitions is None:
+        num_partitions = _num_partitions(hlo_text)
+    bytes_by = {"intra_process": 0, "cross_dcn": 0}
+    cross_kinds: dict[str, int] = {}
+    for m in _COLLECTIVE_RE.finditer(hlo_text):
+        if m.group("suffix") == "-start":
+            continue
+        nl = hlo_text.find("\n", m.end())
+        instr = hlo_text[m.start():nl if nl >= 0 else len(hlo_text)]
+        groups = _parse_replica_groups(instr)
+        if not groups:  # absent or the empty all-spanning form
+            spans = num_partitions > block_devices
+        else:
+            spans = any(
+                len({i // block_devices for i in g}) > 1 for g in groups)
+        vol = _shape_bytes(m.group("shape"))
+        if spans:
+            bytes_by["cross_dcn"] += vol
+            kind = m.group("kind")
+            cross_kinds[kind] = cross_kinds.get(kind, 0) + 1
+        else:
+            bytes_by["intra_process"] += vol
+    return {"bytes": bytes_by, "cross_dcn_kinds": cross_kinds}
 
 
 def _is_sharding(x) -> bool:
@@ -233,6 +315,11 @@ def contract_sharding_facts(
         "argument_bytes_per_device": sum(
             o["per_device_bytes"] for o in operands),
     }
+    if contract.dcn_block_devices:
+        scope = collect_collective_scopes(
+            hlo, contract.dcn_block_devices, num_partitions=partitions)
+        facts["collective_bytes_by_scope"] = scope["bytes"]
+        facts["cross_dcn_collectives"] = scope["cross_dcn_kinds"]
     if contract.donate:
         facts["donation_aliased"] = _donation_aliased(spec, contract.donate)
     return facts
@@ -295,6 +382,20 @@ def audit_sharding_contract(
                 message=f"per-device peak memory {peak} B exceeds the "
                         f"declared HBM budget {contract.hbm_budget_bytes} B "
                         "at the canonical audit shape"))
+
+    if contract.dcn_block_devices:
+        cross = facts["collective_bytes_by_scope"]["cross_dcn"]
+        if cross > contract.dcn_collective_bytes_budget:
+            kinds = facts["cross_dcn_collectives"]
+            found.append(Violation(
+                rule="GA-S006", file=file, line=line,
+                entrypoint=contract.name,
+                message=f"collectives {sorted(kinds)} move {cross} B/device "
+                        "across the DCN axis (replica groups spanning >= 2 "
+                        f"{contract.dcn_block_devices}-device process "
+                        "blocks) — budget "
+                        f"{contract.dcn_collective_bytes_budget} B; "
+                        "peer-axis traffic must stay inside one ICI block"))
 
     if contract.donate and facts.get("donation_aliased") is False:
         found.append(Violation(
@@ -375,9 +476,11 @@ def _eval_fit(fit: tuple[float, float], n: int) -> float:
     return a * float(n) ** p
 
 
-def _rung_partitions(leaf: dict, trials: int, mesh_shape: dict) -> int:
-    """Partition count of one input leaf on the MODELED rung grid, inferred
-    from its measured per-dim partition counts on the audit grid.
+def _rung_partitions(leaf: dict, trials: int, mesh_shape: dict,
+                     dcn: int = 1) -> tuple[int, bool]:
+    """(partition count on the MODELED rung grid, trial-axis flag) of one
+    input leaf, inferred from its measured per-dim partition counts on the
+    audit grid.
 
     Layout rule (parallel/sharding.nested_batch_shardings): stacked
     peer-major (T, N, ...) leaves split over both axes; (T, ...) per-trial
@@ -385,27 +488,36 @@ def _rung_partitions(leaf: dict, trials: int, mesh_shape: dict) -> int:
     submesh. The measured per-dim counts identify which grid axes a leaf
     actually occupies — dim 0 of size T is the trial axis, any other
     partitioned dim is the peer axis — and the rung factor re-evaluates
-    those axes at the rung grid's extents."""
+    those axes at the rung grid's extents. On a modeled multi-host pod
+    (`dcn` > 1) the trial axis additionally splits over the DCN blocks —
+    the stacked-trial extent grows dcn-fold and so does its partition
+    count, so a trial leaf's per-device bytes are DCN-invariant while the
+    pod's GLOBAL trial throughput scales with the process count."""
     g_cur = int(mesh_shape.get("trials", 1))
-    w_cur = int(mesh_shape.get("peers", 1))
     per_dim = leaf["partitions_per_dim"]
     shape = leaf["shape"]
-    factor = 1
+    factor, on_trials = 1, False
     for d, (size, parts) in enumerate(zip(shape, per_dim)):
         if parts <= 1:
             continue
         on_trial_axis = (d == 0 and size == trials and parts <= g_cur)
-        factor *= RUNG_TRIAL_GROUPS if on_trial_axis else RUNG_PEER_WIDTH
-    return factor
+        if on_trial_axis:
+            on_trials = True
+            factor *= RUNG_TRIAL_GROUPS * dcn
+        else:
+            factor *= RUNG_PEER_WIDTH
+    return factor, on_trials
 
 
 def predict_rung_certificate(
         peer_counts=(64, 128, 256, 512), *, rung_peers: int = RUNG_PEERS,
         steps: int = 20, connect_to: int = 10, local_trials: int = 2,
-        hbm_bytes: int = V5E8_HBM_BYTES, spec_builder=None) -> dict:
+        hbm_bytes: int = V5E8_HBM_BYTES, spec_builder=None,
+        dcn: int = 1, scenario: str = "sybil_graft_flood") -> dict:
     """Lower the config-8 attack-window program at several peer counts,
-    fit per-leaf footprint power laws, and emit the strict-JSON 1M-rung
-    feasibility certificate for a modeled v5e-8.
+    fit per-leaf footprint power laws, and emit the strict-JSON rung
+    feasibility certificate for a modeled v5e-8 (or, with ``dcn`` > 1, a
+    modeled ``dcn``-host pod of v5e-8 slices joined over DCN).
 
     Per fit point: every input leaf's GLOBAL bytes (grid-independent) plus
     the per-device output/temp totals from XLA's memory analysis. Input
@@ -413,7 +525,14 @@ def predict_rung_certificate(
     output/temp extrapolate per-device and re-scale by the audit-grid /
     rung-grid peer-width ratio (they are row-block-proportional). The
     largest point is held out to validate the fit (acceptance bar: within
-    10%); the final extrapolation refits on every point."""
+    10%); the final extrapolation refits on every point.
+
+    The DCN factor models the make_dcn_mesh placement: each host runs the
+    2 x 4 trials x peers grid on its own stacked-trial slice, so a
+    trial-axis leaf's global bytes AND partitions both scale by ``dcn``
+    (per-device unchanged), shared peer-axis arrays replicate per block,
+    and the fits-or-not verdict stays a per-chip HBM question — what
+    changes at 4M peers is the leaves' n-scaling, not the grid math."""
     from ..parallel.sharding import make_trial_mesh
     from .registry import attack_rung_spec
 
@@ -426,6 +545,8 @@ def predict_rung_certificate(
     peer_counts = sorted(int(n) for n in peer_counts)
     if len(peer_counts) < 3:
         raise ValueError("need >= 3 peer counts to fit and validate")
+    if dcn < 1:
+        raise ValueError(f"dcn must be >= 1, got {dcn}")
     mesh = make_trial_mesh(RUNG_TRIAL_GROUPS)
     mesh_shape = {k: int(v) for k, v in mesh.shape.items()}
     trials = RUNG_TRIAL_GROUPS * local_trials
@@ -490,9 +611,13 @@ def predict_rung_certificate(
     for i, name in enumerate(names):
         ys = [pt["operands"][i]["global_bytes"] for pt in points]
         fit = fit_power_law(ns_all, ys)
-        parts = _rung_partitions(points[-1]["operands"][i], trials,
-                                 mesh_shape)
+        parts, on_trials = _rung_partitions(
+            points[-1]["operands"][i], trials, mesh_shape, dcn=dcn)
         pred_global = _eval_fit(fit, rung_peers)
+        if on_trials:
+            # dcn x more stacked trials on the modeled pod; the matching
+            # dcn factor inside `parts` keeps per-device bytes invariant
+            pred_global *= dcn
         pred_dev = pred_global / parts
         arg_total += pred_dev
         leaves_out.append({
@@ -516,15 +641,17 @@ def predict_rung_certificate(
 
     return {
         "rung": {
-            "peers": int(rung_peers), "trials": trials,
+            "peers": int(rung_peers), "trials": trials * dcn,
             "trial_groups": RUNG_TRIAL_GROUPS,
             "peer_width": RUNG_PEER_WIDTH,
+            "dcn": int(dcn),
             "attack_heartbeats": int(steps),
             "connect_to": int(connect_to),
-            "scenario": "sybil_graft_flood",
+            "scenario": scenario,
         },
         "modeled_device": {
-            "name": "v5e-8", "chips": V5E8_CHIPS,
+            "name": "v5e-8" if dcn == 1 else f"{dcn}x-v5e-8",
+            "chips": V5E8_CHIPS * dcn,
             "hbm_bytes_per_chip": int(hbm_bytes),
         },
         "audit_grid": mesh_shape,

@@ -1253,6 +1253,16 @@ def cmd_lint(argv: list[str]) -> int:
                    help="also fit the attack-window footprint curves and "
                         "emit the rung feasibility certificate for PEERS "
                         "(default 1048576) on a modeled v5e-8")
+    p.add_argument("--rung-dcn", type=int, default=1, metavar="HOSTS",
+                   help="model the rung on a HOSTS-strong pod of v5e-8 "
+                        "slices joined over DCN (make_dcn_mesh placement: "
+                        "each host holds its own stacked-trial slice; "
+                        "default 1 = the single-slice rung)")
+    p.add_argument("--rung-scenario", choices=("attack", "arena"),
+                   default="attack",
+                   help="which window family to fit: the GossipSub attack "
+                        "window (default) or the protocol-arena window "
+                        "with its EpisubCtrl leaves")
     p.add_argument("--rung-out", default=None, metavar="PATH",
                    help="also write the rung certificate alone to PATH "
                         "(strict JSON; the report embeds it either way)")
@@ -1310,7 +1320,18 @@ def cmd_lint(argv: list[str]) -> int:
     if a.predict_rung is not None:
         from .analysis.sharding_audit import predict_rung_certificate
 
-        rung_cert = predict_rung_certificate(rung_peers=a.predict_rung)
+        spec_builder = None
+        scenario = "sybil_graft_flood"
+        if a.rung_scenario == "arena":
+            from .analysis.registry import arena_rung_spec
+
+            def spec_builder(n):
+                return arena_rung_spec(n)
+
+            scenario = "protocol_arena/episub"
+        rung_cert = predict_rung_certificate(
+            rung_peers=a.predict_rung, dcn=a.rung_dcn,
+            spec_builder=spec_builder, scenario=scenario)
         if a.rung_out:
             with open(a.rung_out, "w") as fh:
                 json.dump(rung_cert, fh, indent=2, sort_keys=True,
@@ -1358,6 +1379,15 @@ def cmd_conform(argv: list[str]) -> int:
     p.add_argument("--warm-steps", type=int, default=4)
     p.add_argument("--seeds", type=int, nargs="+", default=[0],
                    help="fuzz seeds; each reseeds graph, state and cohort")
+    p.add_argument("--fuzz", type=int, default=0, metavar="N",
+                   help="append N random-parameter-grid entries: each "
+                        "samples degree bounds (0 < d_low <= d <= d_high "
+                        "<= capacity), gossip factor and score weights, "
+                        "then runs the differential under that grid, "
+                        "cycling through the attack canon. One jit compile "
+                        "per sample")
+    p.add_argument("--fuzz-seed", type=int, default=0,
+                   help="PRNG stream for --fuzz grid sampling (default 0)")
     p.add_argument("--out", default=None,
                    help="certificate path (default: stdout)")
     a = p.parse_args(argv)
@@ -1371,7 +1401,7 @@ def cmd_conform(argv: list[str]) -> int:
         scenarios=a.scenario, n=a.n, connect_to=a.connect_to,
         seeds=tuple(a.seeds), steps=a.steps, warm_steps=a.warm_steps,
         include_adaptive=full, include_faults=full, include_churn=full,
-        include_gossip=full)
+        include_gossip=full, fuzz=a.fuzz, fuzz_seed=a.fuzz_seed)
     if a.out:
         write_certificate(cert, a.out)
     else:

@@ -128,14 +128,89 @@ def make_trial_mesh(trial_groups: int | None = None,
     return Mesh(grid, (TRIAL_AXIS, "peers"))
 
 
+DCN_AXIS = "dcn"
+
+
+def make_dcn_mesh(dcn: int | None = None,
+                  trial_groups: int | None = None,
+                  n_devices: int | None = None,
+                  platform: str | None = None) -> Mesh:
+    """Three-level dcn x trials x peers grid over the GLOBAL device set.
+
+    The multi-host extension of make_trial_mesh (ROADMAP "go past one
+    host"): axis 0 ("dcn") is PROCESS granularity — each dcn block is one
+    host's addressable devices, so every "peers"-axis collective the nested
+    window programs emit stays strictly inside a host's ICI submesh and
+    only trial-axis work (which is embarrassingly parallel) ever spans the
+    DCN boundary. Devices are ordered process-major (sorted by
+    process_index) so dcn block b == process b's chips — the invariant the
+    GA-S006 auditor's block classification and local_trial_submesh both
+    rely on. `dcn` defaults to jax.process_count(); `trial_groups` is the
+    PER-BLOCK trial-group count (defaults to 2 when the block has >= 2
+    devices, mirroring audit_trial_groups)."""
+    devs = jax.devices(platform)
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    devs = sorted(devs, key=lambda d: (d.process_index, d.id))
+    if dcn is None:
+        dcn = jax.process_count()
+    if dcn < 1 or len(devs) % dcn != 0:
+        raise ValueError(
+            f"dcn {dcn} must divide the device count {len(devs)} evenly")
+    per_block = len(devs) // dcn
+    if trial_groups is None:
+        trial_groups = 2 if per_block >= 2 else 1
+    if trial_groups < 1 or per_block % trial_groups != 0:
+        raise ValueError(
+            f"trial_groups {trial_groups} must divide the per-block device "
+            f"count {per_block} evenly")
+    grid = np.array(devs).reshape(dcn, trial_groups, per_block // trial_groups)
+    if dcn == jax.process_count() > 1:
+        for b in range(dcn):
+            procs = {d.process_index for d in grid[b].flat}
+            if len(procs) != 1:
+                raise ValueError(
+                    f"dcn block {b} spans processes {sorted(procs)}; the "
+                    f"DCN axis must be process granularity (peer collectives "
+                    f"would cross the DCN boundary)")
+    return Mesh(grid, (DCN_AXIS, TRIAL_AXIS, "peers"))
+
+
+def local_trial_submesh(mesh: Mesh) -> Mesh:
+    """This process's 2-D trials x peers submesh of a make_dcn_mesh grid.
+
+    The runtime half of the DCN split: the campaign executes the SAME
+    jitted nested window per process on its addressable block (supervisor
+    retries, checkpoints, and recovery legs stay process-local), while the
+    3-level mesh exists for placement reasoning and the static GA-S006
+    audit. On a mesh without a dcn axis this is the identity."""
+    if DCN_AXIS not in mesh.axis_names:
+        return mesh
+    rank = jax.process_index()
+    grid = mesh.devices
+    for b in range(grid.shape[0]):
+        if all(d.process_index == rank for d in grid[b].flat):
+            return Mesh(grid[b], (TRIAL_AXIS, "peers"))
+    raise ValueError(
+        f"no dcn block of {mesh} is wholly addressable by process {rank}")
+
+
 def trial_sharding(mesh: Mesh) -> NamedSharding:
-    """Leading-axis (stacked-trial) sharding over a make_trial_mesh grid."""
+    """Leading-axis (stacked-trial) sharding over a make_trial_mesh grid;
+    on a 3-level make_dcn_mesh grid the stacked axis splits over dcn AND
+    trial groups (dcn-major, matching the seed round-robin)."""
+    if DCN_AXIS in mesh.axis_names:
+        return NamedSharding(mesh, P((DCN_AXIS, TRIAL_AXIS)))
     return NamedSharding(mesh, P(TRIAL_AXIS))
 
 
 def nested_sharding(mesh: Mesh) -> NamedSharding:
     """Both-axes sharding for stacked peer-major leaves (T, N, ...): trials
-    over the "trials" axis, peer rows over each group's "peers" submesh."""
+    over the "trials" axis (and the "dcn" axis on a 3-level grid), peer
+    rows over each group's "peers" submesh — peer-axis collectives stay
+    inside one ICI block by construction."""
+    if DCN_AXIS in mesh.axis_names:
+        return NamedSharding(mesh, P((DCN_AXIS, TRIAL_AXIS), "peers"))
     return NamedSharding(mesh, P(TRIAL_AXIS, "peers"))
 
 
@@ -244,3 +319,68 @@ def shard_simulation(state, arrays: dict, topo: dict, mesh: Mesh):
         sh = rows if (v.ndim >= 1 and v.shape[0] == state.mesh_mask.shape[0]) else rep
         topo_placed[k] = jax.device_put(v, sh)
     return state, arrays, topo_placed
+
+
+# Fixed lane width for every dcn_allreduce payload. Uniform message sizes
+# are load-bearing, not cosmetic: the campaign issues back-to-back reduces
+# of different logical widths (fence 1, aggregates 2, wall 1), and on an
+# oversubscribed host one rank can enter reduce N+1 while its peer still
+# drains reduce N — gloo buffers the early bytes as "unexpected" messages,
+# which only works when the posted recv is at least as large as the inbound
+# preamble (op.preamble.length <= op.nbytes fails otherwise, killing the
+# process group). Padding every call to one width removes the mismatched-
+# size class entirely; _dcn_reducer reuse below removes the per-call
+# re-jit so all reduces of one op share a single executable/communicator.
+_DCN_LANES = 4
+
+_dcn_reducers: dict = {}
+
+
+def _dcn_reducer(op: str, mesh: Mesh, width: int):
+    """One cached jitted reduction per (op, device clique, width)."""
+    import jax.numpy as jnp
+
+    key = (op, tuple(d.id for d in mesh.devices.flat), width)
+    fn = _dcn_reducers.get(key)
+    if fn is None:
+        body = (lambda a: jnp.sum(a, axis=0)) if op == "sum" \
+            else (lambda a: jnp.max(a, axis=0))
+        fn = jax.jit(body, out_shardings=NamedSharding(mesh, P()))
+        _dcn_reducers[key] = fn
+    return fn
+
+
+def dcn_allreduce(vec, op: str = "sum") -> np.ndarray:
+    """All-reduce a small per-process host vector across every process.
+
+    The campaign's cross-process channel for the few global aggregates
+    (trial counts, retry totals, wall max) — everything else merges through
+    per-rank artifact files. Each process contributes its vector on its
+    first addressable device (identity elements elsewhere); one jitted
+    reduction over a 1-D all-devices mesh turns into a single DCN
+    all-reduce, and because every process must reach it before any can
+    leave, the call doubles as the barrier the rank-file merge needs.
+    Payloads are padded to _DCN_LANES-float lanes (see above). Returns the
+    reduced vector as float32 numpy; `op` is "sum" or "max"."""
+    if op not in ("sum", "max"):
+        raise ValueError(f"op must be 'sum' or 'max', got {op!r}")
+    devs = sorted(jax.devices(), key=lambda d: (d.process_index, d.id))
+    vec = np.asarray(vec, np.float32).reshape(-1)
+    size = vec.size
+    width = max(_DCN_LANES, -(-size // _DCN_LANES) * _DCN_LANES)
+    # identity element per op so the padding lanes never perturb the result
+    fill = np.float32(0.0 if op == "sum" else -np.inf)
+    padded = np.full(width, fill, np.float32)
+    padded[:size] = vec
+    idle = np.full_like(padded, fill)
+    mesh = Mesh(np.array(devs), ("all",))
+    sh = NamedSharding(mesh, P("all"))
+    first = jax.local_devices()[0]
+    shards = [
+        jax.device_put((padded if d == first else idle)[None, :], d)
+        for d in jax.local_devices()
+    ]
+    arr = jax.make_array_from_single_device_arrays(
+        (len(devs), width), sh, shards)
+    reduced = _dcn_reducer(op, mesh, width)(arr)
+    return np.asarray(reduced)[:size]

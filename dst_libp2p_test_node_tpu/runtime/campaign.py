@@ -89,6 +89,7 @@ device-grid level, not per-run.
 
 from __future__ import annotations
 
+import json
 import math
 import os
 import time
@@ -835,7 +836,10 @@ def _unstack_trial(tree_fn, stacked_out, j: int):
     against."""
     import jax
 
-    dev0 = jax.devices()[0]
+    # local_devices, not devices: under a multi-process (DCN) run the global
+    # devices[0] belongs to rank 0 and a device_put onto it from any other
+    # rank would fail — the default device is always the first ADDRESSABLE one
+    dev0 = jax.local_devices()[0]
     return tree_fn(lambda x: jax.device_put(x[j], dev0), stacked_out)
 
 
@@ -1480,7 +1484,7 @@ def _attacked_trials(
 
 
 def run_campaign(cfg: CampaignConfig, mesh=None,
-                 trial_mesh=None) -> CampaignResult:
+                 trial_mesh=None, dcn=None) -> CampaignResult:
     """Execute the sweep: every (fraction, seed) cell of the campaign grid.
 
     `mesh`: optional 1-D jax.sharding.Mesh over the PEER axis, threaded to
@@ -1493,7 +1497,20 @@ def run_campaign(cfg: CampaignConfig, mesh=None,
     submesh (sharded_attack_window / sharded_faulted_window /
     sharded_recovery_window), replacing the vmapped single-device stack.
     Mutually exclusive with `mesh`: the trial grid already owns every
-    device, including the peer axis inside each group."""
+    device, including the peer axis inside each group.
+
+    `dcn`: optional 3-D parallel/sharding.make_dcn_mesh grid (or True to
+    build the default one) — multi-process orchestration. Each process runs
+    this same function on its seed slice over its OWN 2-D ICI submesh, then
+    the ranks merge into one canonical CampaignResult (see
+    _run_campaign_dcn). Owns the whole device grid: mutually exclusive with
+    both `mesh` and `trial_mesh`."""
+    if dcn is not None:
+        if mesh is not None or trial_mesh is not None:
+            raise ValueError(
+                "dcn owns the full dcn x trials x peers grid; "
+                "drop mesh/trial_mesh")
+        return _run_campaign_dcn(cfg, dcn)
     if mesh is not None and trial_mesh is not None:
         raise ValueError(
             "pass either mesh (peer-axis sharding) or trial_mesh "
@@ -1587,6 +1604,208 @@ def run_campaign(cfg: CampaignConfig, mesh=None,
         retries_total=retries_total,
         conformance=conformance,
     )
+
+
+# ----------------------------------------------------------------- DCN engine
+
+
+DCN_RANK_FORMAT = 1
+DCN_MERGED_BASENAME = "dcn_merged.json"
+# ceiling on how long one rank waits for its siblings' result files before
+# declaring the group dead (generous: covers a sibling paying full compile
+# while this rank rode the persistent cache)
+_DCN_MERGE_TIMEOUT_S = float(os.environ.get("DCN_MERGE_TIMEOUT_S", "3600"))
+
+
+def _dcn_rank_path(cfg: CampaignConfig, rank: int) -> str:
+    return os.path.join(cfg.checkpoint_dir, f"dcn_rank{rank}.trials.json")
+
+
+def merge_dcn_rank_results(cfg: CampaignConfig, payloads: list[dict],
+                           wall_s: float | None = None) -> CampaignResult:
+    """Fold per-rank DCN payloads into ONE canonical CampaignResult.
+
+    Trials are re-ordered into the single-process sweep order — fractions
+    in cfg.fractions order, seeds in cfg.seeds order inside each fraction —
+    so the merged observables are comparable field-for-field with a
+    single-process nested campaign on the same grid. Validates the rank
+    set is contiguous from 0 and that every seed in cfg.seeds is claimed by
+    exactly one rank (the round-robin slice invariant); a violated claim
+    means two ranks ran the same cell or a rank file is stale, and a merge
+    over it would silently double- or drop-count trials."""
+    ranks = sorted(int(p["rank"]) for p in payloads)
+    if ranks != list(range(len(payloads))):
+        raise ValueError(f"rank set {ranks} is not contiguous from 0")
+    by_rank = {int(p["rank"]): p for p in payloads}
+    claimed: dict[int, int] = {}
+    for p in payloads:
+        for s in p["seeds"]:
+            if int(s) in claimed:
+                raise ValueError(
+                    f"seed {s} claimed by ranks {claimed[int(s)]} "
+                    f"and {p['rank']} — stale or overlapping rank files")
+            claimed[int(s)] = int(p["rank"])
+    missing = [int(s) for s in cfg.seeds if int(s) not in claimed]
+    if missing:
+        raise ValueError(f"seeds {missing} claimed by no rank")
+    by_cell: dict[tuple[float, int], dict] = {}
+    for p in payloads:
+        for t in p["trials"]:
+            by_cell[(float(t["fraction"]), int(t["seed"]))] = t
+    trials = [TrialResult(**by_cell[(float(f), int(s))])
+              for f in cfg.fractions for s in cfg.seeds
+              if (float(f), int(s)) in by_cell]
+    r0 = by_rank[0]
+    hb = r0["hb_budget"]
+    return CampaignResult(
+        scenario=r0["scenario"],
+        network_size=int(r0["network_size"]),
+        trials=trials,
+        # the sanitizer nulled a legitimately-infinite budget on write;
+        # restore it so the merged artifact round-trips identically
+        hb_budget=math.inf if hb is None else float(hb),
+        wall_s=float(wall_s) if wall_s is not None
+        else max(float(p["wall_s"]) for p in payloads),
+        degraded=any(p["degraded"] for p in payloads),
+        quarantined_trials=[q for p in payloads
+                            for q in p["quarantined_trials"]],
+        retries_total=sum(int(p["retries_total"]) for p in payloads),
+        conformance=r0.get("conformance"),
+    )
+
+
+def _run_campaign_dcn(cfg: CampaignConfig, dcn_mesh) -> CampaignResult:
+    """Multi-process campaign over a dcn x trials x peers grid.
+
+    Every process executes the SAME code path: slice the seed column
+    round-robin (seeds[rank::nproc]), run the ordinary single-process
+    campaign on this process's 2-D ICI submesh (supervisor retries,
+    checkpoints and quarantine all stay process-local — no SPMD lockstep
+    to deadlock when one rank retries), publish the slice's results as a
+    strict-JSON rank file, then meet at a single DCN all-reduce. The
+    collective carries the few global aggregates (trial/retry counts,
+    max wall-clock) AND doubles as the barrier that makes every rank's
+    file visible before any rank merges. All ranks return the same merged
+    CampaignResult; rank 0 additionally writes the merged strict-JSON
+    artifact next to the rank files. Requires cfg.checkpoint_dir on a
+    filesystem shared by all processes (trivially true for the
+    single-host multi-process launches the engine targets)."""
+    import jax
+
+    from ..parallel.sharding import (
+        DCN_AXIS,
+        dcn_allreduce,
+        local_trial_submesh,
+        make_dcn_mesh,
+    )
+
+    if dcn_mesh is True:
+        dcn_mesh = make_dcn_mesh()
+    if DCN_AXIS not in dcn_mesh.axis_names:
+        raise ValueError(
+            "dcn expects a 3-level make_dcn_mesh grid (leading 'dcn' axis)")
+    if not cfg.checkpoint_dir:
+        raise ValueError(
+            "DCN campaigns need cfg.checkpoint_dir: the rank-0 merge rides "
+            "per-process rank files (and trial resume is the whole point "
+            "of process-local supervision)")
+    nproc = int(dcn_mesh.shape[DCN_AXIS])
+    if nproc != jax.process_count():
+        raise ValueError(
+            f"dcn axis size {nproc} != process_count {jax.process_count()} "
+            "— one DCN block per process is the placement contract")
+    rank = jax.process_index()
+    if len(cfg.seeds) < nproc:
+        raise ValueError(
+            f"{len(cfg.seeds)} seeds over {nproc} processes leaves a rank "
+            "idle; give every process at least one seed")
+    os.makedirs(cfg.checkpoint_dir, exist_ok=True)
+    # start fence: every rank clears ITS OWN stale rank file, then meets at
+    # a throwaway all-reduce. After it, no file from a previous run exists,
+    # which is what licenses the cheap existence-poll below
+    try:
+        os.remove(_dcn_rank_path(cfg, rank))
+    except FileNotFoundError:
+        pass
+    dcn_allreduce(np.zeros(1, dtype=np.float32), op="sum")
+    t0 = time.time()
+    local_mesh = local_trial_submesh(dcn_mesh)
+    local_seeds = tuple(cfg.seeds)[rank::nproc]
+    # conformance is a small-N CPU certificate independent of the seed
+    # slice — run it once, on rank 0, not nproc times
+    local_cfg = replace(cfg, seeds=local_seeds,
+                        conformance=cfg.conformance and rank == 0)
+    local = run_campaign(local_cfg, trial_mesh=local_mesh)
+
+    payload = {
+        "format_version": DCN_RANK_FORMAT,
+        "rank": int(rank),
+        "nproc": int(nproc),
+        "seeds": [int(s) for s in local_seeds],
+        "scenario": local.scenario,
+        "network_size": int(local.network_size),
+        "hb_budget": local.hb_budget,
+        "wall_s": local.wall_s,
+        "degraded": bool(local.degraded),
+        "retries_total": int(local.retries_total),
+        "quarantined_trials": list(local.quarantined_trials),
+        "conformance": local.conformance,
+        "trials": [t.to_dict() for t in local.trials],
+    }
+    path = _dcn_rank_path(cfg, rank)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(sanitize_nonfinite(payload), f, allow_nan=False,
+                  sort_keys=True)
+    os.replace(tmp, path)
+
+    # sleep-poll until every sibling's rank file exists BEFORE entering the
+    # collective: a gloo all-reduce spin-waits for stragglers, which on an
+    # oversubscribed host steals the very cores the straggler needs (and a
+    # sweep longer than the collective timeout would kill the group). File
+    # existence is completion — os.replace is atomic and the start fence
+    # removed every stale file
+    deadline = time.time() + _DCN_MERGE_TIMEOUT_S
+    while not all(os.path.exists(_dcn_rank_path(cfg, r))
+                  for r in range(nproc)):
+        if time.time() > deadline:
+            missing = [r for r in range(nproc)
+                       if not os.path.exists(_dcn_rank_path(cfg, r))]
+            raise RuntimeError(
+                f"rank {rank}: ranks {missing} produced no result within "
+                f"{_DCN_MERGE_TIMEOUT_S:.0f}s — sibling process dead?")
+        time.sleep(0.05)
+
+    # the ONLY cross-process collective of the whole campaign: sum the
+    # global aggregates, max the wall-clock — and, as a side effect, fence
+    # every rank's os.replace above behind every rank's reads below
+    agg = dcn_allreduce(
+        np.array([len(local.trials), local.retries_total], dtype=np.float32),
+        op="sum")
+    wall = float(dcn_allreduce(
+        np.array([time.time() - t0], dtype=np.float32), op="max")[0])
+
+    payloads = []
+    for r in range(nproc):
+        with open(_dcn_rank_path(cfg, r)) as f:
+            payloads.append(json.load(f))
+    merged = merge_dcn_rank_results(cfg, payloads, wall_s=wall)
+    # cross-check the file-based merge against the collective's counters:
+    # a mismatch means a rank file from a previous run leaked in
+    if (len(merged.trials), merged.retries_total) != (int(agg[0]),
+                                                      int(agg[1])):
+        raise RuntimeError(
+            f"merge saw {len(merged.trials)} trials / "
+            f"{merged.retries_total} retries but the DCN all-reduce "
+            f"counted {int(agg[0])} / {int(agg[1])} — stale rank files?")
+    if rank == 0:
+        out = os.path.join(cfg.checkpoint_dir, DCN_MERGED_BASENAME)
+        tmp = f"{out}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(merged.to_dict(), f, allow_nan=False, sort_keys=True,
+                      indent=2)
+        os.replace(tmp, out)
+    return merged
 
 
 def _campaign_conformance(cfg: CampaignConfig, adv: AdversaryParams) -> dict:

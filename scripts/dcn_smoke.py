@@ -29,12 +29,27 @@ Run:  python scripts/dcn_smoke.py            (spawns both workers, checks both)
 from __future__ import annotations
 
 import os
+import socket
 import subprocess
 import sys
 
-PORT = int(os.environ.get("DCN_SMOKE_PORT", "51217"))
 DEVS_PER_PROC = 4
 NUM_PROCS = 2
+
+# stderr fragments that mean the coordinator lost the bind race — the only
+# failure class worth an automatic relaunch on a fresh port
+_BIND_RACE = ("EADDRINUSE", "Address already in use",
+              "address already in use")
+
+
+def free_port() -> int:
+    """Bind-probe: let the kernel assign an ephemeral localhost port, read
+    it back, release. The window between release and jax.distributed's own
+    bind is real but tiny; main() retries the whole launch on EADDRINUSE
+    instead of pretending the race away."""
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
 
 
 def worker(process_id: int) -> None:
@@ -54,8 +69,13 @@ def worker(process_id: int) -> None:
     # env-var platform selection is overridden by this environment's axon
     # sitecustomize (the round-1 lesson recorded in
     # __graft_entry__.dryrun_multichip); the config pin is the only one
-    # that takes precedence, and it must land before the first backend use
+    # that takes precedence, and it must land before the first backend use.
+    # Same story for the gloo selection: the env var above is read when the
+    # jax config module defines the flag, which already happened if ANY
+    # earlier import (sitecustomize) pulled jax in — the config pin always
+    # lands as long as the CPU client hasn't been created yet
     jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
 
     import jax.numpy as jnp
     import numpy as np
@@ -65,8 +85,12 @@ def worker(process_id: int) -> None:
         initialize_multihost, make_peer_mesh, peer_sharding,
     )
 
+    # the coordinator port is chosen by the launcher's bind probe and
+    # threaded through the environment — never hardcoded, so parallel CI
+    # shards / stray earlier runs cannot collide on it
+    port = int(os.environ["DCN_SMOKE_PORT"])
     pid = initialize_multihost(
-        coordinator_address=f"localhost:{PORT}",
+        coordinator_address=f"localhost:{port}",
         num_processes=NUM_PROCS,
         process_id=process_id,
     )
@@ -139,11 +163,13 @@ def worker(process_id: int) -> None:
     )
 
 
-def main() -> int:
+def _launch(port: int) -> tuple[bool, str]:
+    """One two-worker launch attempt on `port`; (ok, combined transcript)."""
     here = os.path.dirname(os.path.abspath(__file__))
     repo = os.path.dirname(here)
     env = dict(os.environ)
     env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    env["DCN_SMOKE_PORT"] = str(port)
     procs = [
         subprocess.Popen(
             [sys.executable, os.path.abspath(__file__), "--worker", str(i)],
@@ -153,10 +179,11 @@ def main() -> int:
         for i in range(NUM_PROCS)
     ]
     ok = True
+    transcript = ""
     try:
         for p in procs:
             out, _ = p.communicate(timeout=300)
-            sys.stdout.write(out)
+            transcript += out
             if p.returncode != 0 or "OK" not in out:
                 ok = False
     except subprocess.TimeoutExpired:
@@ -167,6 +194,24 @@ def main() -> int:
         for p in procs:
             if p.poll() is None:
                 p.kill()
+    return ok, transcript
+
+
+def main() -> int:
+    pinned = os.environ.get("DCN_SMOKE_PORT")
+    attempts = int(os.environ.get("DCN_SMOKE_BIND_RETRIES", "3"))
+    ok, transcript = False, ""
+    for attempt in range(attempts):
+        port = int(pinned) if pinned else free_port()
+        ok, transcript = _launch(port)
+        sys.stdout.write(transcript)
+        if ok:
+            break
+        raced = any(tok in transcript for tok in _BIND_RACE)
+        if pinned or not raced or attempt + 1 == attempts:
+            break
+        print(f"dcn_smoke: port {port} raced (EADDRINUSE), "
+              f"re-probing [{attempt + 1}/{attempts}]", flush=True)
     print("dcn_smoke:", "PASS" if ok else "FAIL")
     return 0 if ok else 1
 

@@ -213,11 +213,12 @@ def test_bench_tripwire_is_keyed_per_config(tmp_path):
     # flip the dispatch-mode suffix (ISSUE 14), the adaptive-attacker
     # probe the -adaptive suffix (ISSUE 15), and the mega-round scan flip
     # the -fused suffix (ISSUE 16), and the protocol-arena probe the
-    # -arena suffix (ISSUE 19) — each opens a FRESH bucket, so the
+    # -arena suffix (ISSUE 19), and the multi-host DCN campaign probe the
+    # -dcn suffix (ISSUE 20) — each opens a FRESH bucket, so the
     # first run of a new shape compares against nothing instead of
     # tripping a false regression against committed rows of the old shape
     assert bench.BENCH_CONFIG == \
-        "n100000-r300-m3-exact-dht-svc-batched-adaptive-fused-arena"
+        "n100000-r300-m3-exact-dht-svc-batched-adaptive-fused-arena-dcn"
     assert bench.best_committed_peer_rounds(
         config_key=bench.BENCH_CONFIG) is None
     assert bench._config_key_of(

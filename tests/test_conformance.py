@@ -150,6 +150,49 @@ def test_conform_cli_single_scenario(tmp_path):
     assert cert["clean"] is True
 
 
+def test_fuzz_sampler_respects_degree_lattice():
+    """Every grid sample_sim_params draws must satisfy the v1.1 config
+    invariants the router assumes (0 < d_low <= d <= d_high <= capacity,
+    d_score <= d, d_out < d_low or d_out == 1, d_out <= max(1, d // 2)) and
+    keep the armed score ordering gossip >= publish >= graylist — a sample
+    outside the lattice would fuzz a config the reference itself rejects."""
+    from dst_libp2p_test_node_tpu.analysis.conformance import sample_sim_params
+    from dst_libp2p_test_node_tpu.ops.state import SimParams
+
+    rng = np.random.default_rng(3)
+    capacity = 12
+    for _ in range(200):
+        k = sample_sim_params(rng, capacity)
+        assert 0 < k["d_low"] <= k["d"] <= k["d_high"] <= capacity
+        assert 1 <= k["d_score"] <= k["d"]
+        assert 1 <= k["d_out"] <= max(1, min(k["d_low"] - 1, k["d"] // 2)) \
+            or k["d_out"] == 1
+        assert 1 <= k["d_lazy"] <= capacity
+        assert 0.05 <= k["gossip_factor"] <= 0.5
+        assert k["slow_weight"] < 0
+        assert (k["gossip_threshold"] > k["publish_threshold"]
+                > k["graylist_threshold"])
+        # every sampled grid must be a constructible params object
+        SimParams(n=48, capacity=capacity, **k)
+
+
+@pytest.mark.slow
+def test_fuzzed_param_grid_differential_is_clean():
+    """One random parameter grid through the differential stays clean —
+    the compiled step conforms beyond the ARMED point the fixed
+    certificate pins (the full --fuzz sweep runs in the CI conformance
+    step; one sample is one extra jit compile)."""
+    from dst_libp2p_test_node_tpu.analysis.conformance import (
+        run_fuzz_differential,
+    )
+
+    (name, knobs, divs), = run_fuzz_differential(
+        1, n=48, connect_to=8, seed=0, steps=4, warm_steps=2, fuzz_seed=1)
+    assert name.startswith("fuzz:")
+    waivers = load_waivers()
+    assert certificate_entry(name, divs, waivers)["sim_bugs"] == 0, divs
+
+
 def test_spec_score_matches_engine():
     """Unit anchor under the differential: the spec's score law is the
     engine's SimState.score on a random counter state."""

@@ -307,6 +307,36 @@ def test_committed_rung_certificate_is_consistent():
         cert["predicted_per_device"]["arguments"], rel=0.01)
 
 
+def test_committed_4m_rung_certificate_is_consistent():
+    """RUNG_4M.json is the committed multi-host verdict: the 4,194,304-peer
+    attacked window on a modeled 4x-v5e-8 pod joined over DCN, with the
+    trial axis (not the peers) carrying the DCN factor."""
+    cert = json.loads((REPO / "RUNG_4M.json").read_text())
+    assert cert["rung"]["peers"] == 4194304
+    assert cert["rung"]["dcn"] == 4
+    assert cert["rung"]["trials"] == 16       # 4 per slice x 4 hosts
+    assert cert["modeled_device"] == {
+        "name": "4x-v5e-8", "chips": 32, "hbm_bytes_per_chip": 16 * 2**30}
+    assert cert["validation"]["within_10pct"]
+    total = cert["predicted_per_device"]["total"]
+    assert (cert["verdict"] == "fits") == (total <= 16 * 2**30)
+    assert cert["verdict"] == "fits"          # the ISSUE-20 claim itself
+
+
+def test_committed_arena_rung_certificate_is_consistent():
+    """RUNG_ARENA.json answers the ROADMAP arena-at-1M question the same
+    compile-time way: the episub arena window's fitted footprint, with the
+    EpisubCtrl carry leaves attributed in the per-leaf fits."""
+    cert = json.loads((REPO / "RUNG_ARENA.json").read_text())
+    assert cert["rung"]["peers"] == 1048576
+    assert cert["rung"]["scenario"] == "protocol_arena/episub"
+    assert cert["validation"]["within_10pct"]
+    assert cert["verdict"] == "fits"
+    names = {leaf["name"] for leaf in cert["leaves"]}
+    assert any("hops" in n for n in names), sorted(names)
+    assert any("parent" in n for n in names), sorted(names)
+
+
 # ---------------------------------------------------------------- layer 4:
 # CLI surface
 
